@@ -1,0 +1,263 @@
+"""Rectangular-region (extent) algebra.
+
+Capability parity with the reference's extent engine (SURVEY.md §2.2:
+``[U] spartan/array/extent.py`` — ``TileExtent(ul, lr, array_shape)``,
+intersection, global/local offset mapping, ``to_slice``/``from_slice``,
+drop-axis, find-overlapping). In the TPU build this is *metadata-plane only*:
+extents describe tile grids and region reads/writes, while the data plane is
+XLA. All functions are pure; extents are immutable and hashable so they can
+be used as dict keys and inside jit static arguments.
+
+A fast C++ twin is planned under ``spartan_tpu/native`` (SURVEY.md §2.5
+obligation); until the switching code lands this module is the only
+implementation and ``FLAGS.use_cpp_extent`` is inert.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Coord = Tuple[int, ...]
+
+
+class TileExtent:
+    """A half-open rectangular region ``[ul, lr)`` of an array of
+    ``array_shape``."""
+
+    __slots__ = ("ul", "lr", "array_shape", "_hash")
+
+    def __init__(self, ul: Sequence[int], lr: Sequence[int],
+                 array_shape: Optional[Sequence[int]] = None):
+        self.ul: Coord = tuple(int(x) for x in ul)
+        self.lr: Coord = tuple(int(x) for x in lr)
+        self.array_shape: Optional[Coord] = (
+            tuple(int(x) for x in array_shape)
+            if array_shape is not None else None)
+        if len(self.ul) != len(self.lr):
+            raise ValueError(f"rank mismatch: {self.ul} vs {self.lr}")
+        for u, l in zip(self.ul, self.lr):
+            if u > l:
+                raise ValueError(f"inverted extent: {self.ul}..{self.lr}")
+        if self.array_shape is not None:
+            if len(self.array_shape) != len(self.ul):
+                raise ValueError("array_shape rank mismatch")
+            for l, s in zip(self.lr, self.array_shape):
+                if l > s:
+                    raise ValueError(
+                        f"extent {self.ul}..{self.lr} exceeds array "
+                        f"shape {self.array_shape}")
+        self._hash = hash((self.ul, self.lr, self.array_shape))
+
+    # -- basic geometry -------------------------------------------------
+
+    @property
+    def shape(self) -> Coord:
+        return tuple(l - u for u, l in zip(self.ul, self.lr))
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def ndim(self) -> int:
+        return len(self.ul)
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, TileExtent) and self.ul == other.ul
+                and self.lr == other.lr
+                and self.array_shape == other.array_shape)
+
+    def __repr__(self) -> str:
+        return f"Extent({self.ul}..{self.lr} of {self.array_shape})"
+
+    # -- conversions ----------------------------------------------------
+
+    def to_slice(self) -> Tuple[slice, ...]:
+        return tuple(slice(u, l) for u, l in zip(self.ul, self.lr))
+
+    def to_global(self, local_idx: Sequence[int]) -> Coord:
+        return tuple(u + i for u, i in zip(self.ul, local_idx))
+
+    def to_local(self, global_idx: Sequence[int]) -> Coord:
+        return tuple(i - u for u, i in zip(self.ul, global_idx))
+
+    def ravelled_pos(self) -> int:
+        """Linear offset of ``ul`` within the full array (C order)."""
+        if self.array_shape is None:
+            raise ValueError("ravelled_pos requires array_shape")
+        pos = 0
+        for u, s in zip(self.ul, self.array_shape):
+            pos = pos * s + u
+        return pos
+
+    def drop_axis(self, axis: int) -> "TileExtent":
+        """Remove one axis (the extent of a reduction's output region)."""
+        axis = axis % self.ndim
+        ul = self.ul[:axis] + self.ul[axis + 1:]
+        lr = self.lr[:axis] + self.lr[axis + 1:]
+        shape = (None if self.array_shape is None else
+                 self.array_shape[:axis] + self.array_shape[axis + 1:])
+        return TileExtent(ul, lr, shape)
+
+    def add_axis(self, axis: int, dim: int = 1) -> "TileExtent":
+        axis = axis % (self.ndim + 1)
+        ul = self.ul[:axis] + (0,) + self.ul[axis:]
+        lr = self.lr[:axis] + (dim,) + self.lr[axis:]
+        shape = (None if self.array_shape is None else
+                 self.array_shape[:axis] + (dim,) + self.array_shape[axis:])
+        return TileExtent(ul, lr, shape)
+
+    # -- algebra --------------------------------------------------------
+
+    def intersection(self, other: "TileExtent") -> Optional["TileExtent"]:
+        ul = tuple(max(a, b) for a, b in zip(self.ul, other.ul))
+        lr = tuple(min(a, b) for a, b in zip(self.lr, other.lr))
+        if any(u >= l for u, l in zip(ul, lr)):
+            return None
+        # Keep intersection symmetric: prefer whichever operand carries an
+        # array_shape so the result hashes/compares consistently.
+        shape = self.array_shape if self.array_shape is not None \
+            else other.array_shape
+        return TileExtent(ul, lr, shape)
+
+    def contains(self, other: "TileExtent") -> bool:
+        return (all(a <= b for a, b in zip(self.ul, other.ul))
+                and all(a >= b for a, b in zip(self.lr, other.lr)))
+
+    def offset_from(self, outer: "TileExtent") -> "TileExtent":
+        """Express ``self`` in the local coordinates of ``outer``
+        (``self`` must lie inside ``outer``)."""
+        if not outer.contains(self):
+            raise ValueError(f"{self} not inside {outer}")
+        ul = tuple(a - b for a, b in zip(self.ul, outer.ul))
+        lr = tuple(a - b for a, b in zip(self.lr, outer.ul))
+        return TileExtent(ul, lr, outer.shape)
+
+    def offset_slice(self, inner: "TileExtent") -> Tuple[slice, ...]:
+        """Slice selecting ``inner`` out of a buffer shaped like ``self``."""
+        return inner.offset_from(self).to_slice()
+
+
+def create(ul: Sequence[int], lr: Sequence[int],
+           array_shape: Optional[Sequence[int]] = None) -> TileExtent:
+    return TileExtent(ul, lr, array_shape)
+
+
+def from_shape(shape: Sequence[int]) -> TileExtent:
+    return TileExtent((0,) * len(shape), shape, shape)
+
+
+def from_slice(idx, shape: Sequence[int]) -> TileExtent:
+    """Build the extent selected by a (tuple of) slice/int over ``shape``.
+
+    Integer indices keep their axis with extent 1 (callers squeeze).
+    Negative indices and open slices are normalized. Steps != 1 are
+    rejected here; strided access is handled at the expr layer.
+    """
+    shape = tuple(int(s) for s in shape)
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    if len(idx) > len(shape):
+        raise IndexError(f"too many indices {idx} for shape {shape}")
+    idx = idx + (slice(None),) * (len(shape) - len(idx))
+    ul: List[int] = []
+    lr: List[int] = []
+    for i, (ix, dim) in enumerate(zip(idx, shape)):
+        if isinstance(ix, slice):
+            start, stop, step = ix.indices(dim)
+            if step != 1:
+                raise ValueError("strided slices unsupported in extent algebra")
+            ul.append(start)
+            lr.append(max(start, stop))
+        elif isinstance(ix, (int, np.integer)):
+            ii = int(ix)
+            if ii < 0:
+                ii += dim
+            if not 0 <= ii < dim:
+                raise IndexError(f"index {ix} out of bounds for axis {i} "
+                                 f"with size {dim}")
+            ul.append(ii)
+            lr.append(ii + 1)
+        else:
+            raise TypeError(f"unsupported index {ix!r}")
+    return TileExtent(ul, lr, shape)
+
+
+def intersection(a: TileExtent, b: TileExtent) -> Optional[TileExtent]:
+    return a.intersection(b)
+
+
+def find_overlapping(extents: Sequence[TileExtent],
+                     region: TileExtent) -> List[TileExtent]:
+    """All extents intersecting ``region`` (the tile-lookup primitive used
+    by region fetch/update)."""
+    return [e for e in extents if e.intersection(region) is not None]
+
+
+def all_nonoverlapping(extents: Sequence[TileExtent]) -> bool:
+    for i, a in enumerate(extents):
+        for b in extents[i + 1:]:
+            if a.intersection(b) is not None:
+                return False
+    return True
+
+
+def is_complete(shape: Sequence[int], extents: Sequence[TileExtent]) -> bool:
+    """Do the (non-overlapping) extents exactly cover an array of ``shape``?"""
+    total = int(np.prod([int(s) for s in shape])) if len(shape) else 1
+    return sum(e.size for e in extents) == total and all_nonoverlapping(extents)
+
+
+# -- tile grids ---------------------------------------------------------
+
+
+def compute_splits(dim: int, n: int) -> List[Tuple[int, int]]:
+    """Split ``dim`` into ``n`` contiguous chunks, remainder spread over the
+    leading chunks (matches jax sharding's even-split requirement when
+    dim % n == 0; otherwise used only on the host metadata path)."""
+    n = max(1, min(n, dim)) if dim > 0 else 1
+    base, extra = divmod(dim, n)
+    splits = []
+    lo = 0
+    for i in range(n):
+        hi = lo + base + (1 if i < extra else 0)
+        splits.append((lo, hi))
+        lo = hi
+    return splits
+
+
+def tile_grid(shape: Sequence[int],
+              tiles_per_dim: Sequence[int]) -> List[TileExtent]:
+    """Regular grid of extents: ``tiles_per_dim[i]`` chunks along axis i,
+    in row-major tile order."""
+    shape = tuple(int(s) for s in shape)
+    per_axis = [compute_splits(d, n) for d, n in zip(shape, tiles_per_dim)]
+    out = []
+    for combo in itertools.product(*per_axis):
+        ul = tuple(c[0] for c in combo)
+        lr = tuple(c[1] for c in combo)
+        out.append(TileExtent(ul, lr, shape))
+    return out
+
+
+def tiles_like_hint(shape: Sequence[int], tile_hint: Sequence[int]
+                    ) -> List[TileExtent]:
+    """Grid from a tile-size hint (the reference's ``tile_hint``: desired
+    per-tile shape)."""
+    shape = tuple(int(s) for s in shape)
+    tiles_per_dim = [max(1, -(-d // max(1, int(t))))
+                     for d, t in zip(shape, tile_hint)]
+    return tile_grid(shape, tiles_per_dim)
+
+
+def index_for(extents: Sequence[TileExtent]) -> Dict[TileExtent, int]:
+    return {e: i for i, e in enumerate(extents)}

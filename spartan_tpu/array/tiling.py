@@ -1,0 +1,189 @@
+"""Tiling vocabulary: Spartan tilings as mesh shardings.
+
+The reference expresses layouts as tile grids chosen by ``tile_hint`` and
+the smart-tiling pass (row / col / block tilings — SURVEY.md §2.6). Here a
+``Tiling`` names which *mesh axes* split which *array axes*; it converts to
+a ``PartitionSpec`` for GSPMD and to the equivalent list of ``TileExtent``s
+for the metadata plane (region fetch/update, shuffle planning). A Tile of
+the reference is exactly one shard here (BASELINE.json:5 "each Tile a
+device shard").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as mesh_mod
+from ..parallel.mesh import AXIS_COL, AXIS_ROW
+from . import extent as extent_mod
+from .extent import TileExtent
+
+
+class Tiling:
+    """Assignment of mesh axes to array axes.
+
+    ``axes[i]`` is the mesh-axis name sharding array axis ``i`` (or None for
+    unsharded). Hashable; used in compile-cache keys.
+    """
+
+    __slots__ = ("axes",)
+
+    def __init__(self, axes: Sequence[Optional[str]]):
+        self.axes: Tuple[Optional[str], ...] = tuple(axes)
+
+    def __hash__(self) -> int:
+        return hash(self.axes)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Tiling) and self.axes == other.axes
+
+    def __repr__(self) -> str:
+        return f"Tiling({self.axes})"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.axes)
+
+    def spec(self) -> P:
+        return P(*self.axes)
+
+    def sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
+        return NamedSharding(mesh or mesh_mod.get_mesh(), self.spec())
+
+    def sharded_axes(self) -> List[int]:
+        return [i for i, a in enumerate(self.axes) if a is not None]
+
+    def mesh_axis_of(self, array_axis: int) -> Optional[str]:
+        return self.axes[array_axis]
+
+    def drop_axis(self, axis: int) -> "Tiling":
+        axis = axis % self.ndim
+        return Tiling(self.axes[:axis] + self.axes[axis + 1:])
+
+    def add_axis(self, axis: int, mesh_axis: Optional[str] = None) -> "Tiling":
+        axis = axis % (self.ndim + 1)
+        return Tiling(self.axes[:axis] + (mesh_axis,) + self.axes[axis:])
+
+    def transpose(self, perm: Sequence[int]) -> "Tiling":
+        return Tiling(tuple(self.axes[p] for p in perm))
+
+    def with_axis(self, axis: int, mesh_axis: Optional[str]) -> "Tiling":
+        axes = list(self.axes)
+        axes[axis] = mesh_axis
+        return Tiling(axes)
+
+    # -- tile-grid view (metadata plane) --------------------------------
+
+    def tiles_per_dim(self, mesh: Optional[Mesh] = None) -> Tuple[int, ...]:
+        mesh = mesh or mesh_mod.get_mesh()
+
+        def axis_size(a) -> int:
+            if a is None:
+                return 1
+            if isinstance(a, tuple):  # multi-axis split, e.g. ('x', 'y')
+                n = 1
+                for sub in a:
+                    n *= mesh.shape[sub]
+                return n
+            return mesh.shape[a]
+
+        return tuple(axis_size(a) for a in self.axes)
+
+    def extents(self, shape: Sequence[int],
+                mesh: Optional[Mesh] = None) -> List[TileExtent]:
+        """The shard extents this tiling induces on ``shape`` (row-major
+        over mesh axes, matching HloSharding tile order)."""
+        return extent_mod.tile_grid(shape, self.tiles_per_dim(mesh))
+
+    def divisible(self, shape: Sequence[int],
+                  mesh: Optional[Mesh] = None) -> bool:
+        """True if every sharded axis divides evenly (required for
+        shard_map paths; GSPMD pads otherwise)."""
+        for d, n in zip(shape, self.tiles_per_dim(mesh)):
+            if n > 1 and d % n != 0:
+                return False
+        return True
+
+
+# -- canonical tilings --------------------------------------------------
+
+
+def replicated(ndim: int) -> Tiling:
+    return Tiling((None,) * ndim)
+
+
+def row(ndim: int) -> Tiling:
+    """Shard the leading axis over the whole mesh's row axis."""
+    if ndim == 0:
+        return Tiling(())
+    return Tiling((AXIS_ROW,) + (None,) * (ndim - 1))
+
+
+def col(ndim: int) -> Tiling:
+    """Shard the second axis (requires ndim >= 2)."""
+    if ndim < 2:
+        return replicated(ndim)
+    return Tiling((None, AXIS_COL) + (None,) * (ndim - 2))
+
+
+def block(ndim: int) -> Tiling:
+    """2-D block tiling of the leading two axes."""
+    if ndim < 2:
+        return row(ndim)
+    return Tiling((AXIS_ROW, AXIS_COL) + (None,) * (ndim - 2))
+
+
+def flat_row(ndim: int) -> Tiling:
+    """Row tiling using both mesh axes on axis 0 — maximal 1-D split.
+
+    Note: PartitionSpec supports tuples of axes; represent as the pair."""
+    if ndim == 0:
+        return Tiling(())
+    return Tiling(((AXIS_ROW, AXIS_COL),) + (None,) * (ndim - 1))
+
+
+def from_tile_hint(shape: Sequence[int], tile_hint: Sequence[int],
+                   mesh: Optional[Mesh] = None) -> Tiling:
+    """Map the reference's ``tile_hint`` (desired per-tile shape) onto the
+    nearest expressible mesh tiling: axes whose hint is smaller than the
+    dim get sharded, in order, onto available mesh axes."""
+    mesh = mesh or mesh_mod.get_mesh()
+    shape = tuple(int(s) for s in shape)
+    want_split = [i for i, (d, t) in enumerate(zip(shape, tile_hint))
+                  if int(t) < d]
+    axes: List[Optional[str]] = [None] * len(shape)
+    avail = [a for a in (AXIS_ROW, AXIS_COL) if mesh.shape.get(a, 1) > 1]
+    for i, array_axis in enumerate(want_split[:len(avail)]):
+        axes[array_axis] = avail[i]
+    return Tiling(axes)
+
+
+def default_tiling(shape: Sequence[int],
+                   mesh: Optional[Mesh] = None) -> Tiling:
+    """Default placement: shard the largest divisible axis on the mesh row
+    axis (and the next largest on col if it helps) — the analogue of the
+    reference's 'split largest dims so #tiles ≈ #workers' default."""
+    mesh = mesh or mesh_mod.get_mesh()
+    ndim = len(shape)
+    if ndim == 0:
+        return Tiling(())
+    nx, ny = mesh_mod.mesh_axis_sizes(mesh)
+    axes: List[Optional[str]] = [None] * ndim
+    order = sorted(range(ndim), key=lambda i: -int(shape[i]))
+    placed_row = placed_col = False
+    for i in order:
+        d = int(shape[i])
+        if not placed_row and nx > 1 and d % nx == 0 and d >= nx:
+            axes[i] = AXIS_ROW
+            placed_row = True
+        elif not placed_col and ny > 1 and d % ny == 0 and d >= ny:
+            axes[i] = AXIS_COL
+            placed_col = True
+    return Tiling(axes)
+
+
+def spec_to_tiling(spec: P, ndim: int) -> Tiling:
+    axes = list(spec) + [None] * (ndim - len(spec))
+    return Tiling(axes[:ndim])

@@ -1,0 +1,107 @@
+"""Device mesh management.
+
+Replaces the reference's cluster bring-up entirely (SURVEY.md §3.1: master
+spawn + worker registration + BlobCtx install collapses to mesh
+construction). A single ambient mesh plays the role the ambient ``BlobCtx``
+played: every DistArray is sharded over it.
+
+Mesh axes:
+  * ``"x"`` — the primary tiling axis (rows / batch). Data-parallel axis.
+  * ``"y"`` — the secondary tiling axis (cols / model). Tensor-parallel axis.
+
+A 2-D mesh is built by default whenever the device count is composite, so
+row (``P('x', None)``), col (``P(None, 'y')``) and block (``P('x', 'y')``)
+tilings are all expressible — the reference's tiling vocabulary
+(SURVEY.md §2.6). On one device the mesh is 1×1 and every spec degrades to
+replicated, so code is mesh-size agnostic (SURVEY.md §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.config import FLAGS
+
+AXIS_ROW = "x"
+AXIS_COL = "y"
+
+_state = threading.local()
+
+
+def _factor_2d(n: int) -> Tuple[int, int]:
+    """Split n devices into the most-square (rows, cols) grid, favoring
+    more rows (the batch axis carries most parallelism in the workloads)."""
+    best = (n, 1)
+    for c in range(1, int(math.isqrt(n)) + 1):
+        if n % c == 0:
+            best = (n // c, c)
+    return best
+
+
+def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
+               shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Build an (x, y) mesh over ``devices`` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if FLAGS.default_mesh_1d and FLAGS.default_mesh_1d > 0:
+        n = min(n, FLAGS.default_mesh_1d)
+        devices = devices[:n]
+    if shape is None:
+        shape = _factor_2d(n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, (AXIS_ROW, AXIS_COL))
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    _state.mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    mesh = getattr(_state, "mesh", None)
+    if mesh is None:
+        mesh = build_mesh()
+        _state.mesh = mesh
+    return mesh
+
+
+class use_mesh:
+    """Context manager pinning the ambient mesh (tests use a CPU mesh)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._prev: Optional[Mesh] = None
+
+    def __enter__(self) -> Mesh:
+        self._prev = getattr(_state, "mesh", None)
+        _state.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        _state.mesh = self._prev
+
+
+def mesh_axis_sizes(mesh: Optional[Mesh] = None) -> Tuple[int, int]:
+    mesh = mesh or get_mesh()
+    return (mesh.shape[AXIS_ROW], mesh.shape[AXIS_COL])
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), P())
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_mesh(), spec)
+
+
+def device_count(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return int(np.prod(list(mesh.shape.values())))

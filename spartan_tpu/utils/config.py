@@ -1,0 +1,167 @@
+"""Typed global FLAGS registry.
+
+Capability parity with the reference's flag/config system (SURVEY.md §2.1:
+``[U] spartan/config.py`` — global ``FLAGS``, typed flags, per-subsystem
+registration, per-optimizer-pass toggles). Re-designed for the TPU build:
+no cluster-topology flags (there is no master/worker runtime); instead the
+flags gate optimizer passes, mesh construction and profiling, which is what
+the benchmark ablations need (SURVEY.md §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Flag:
+    """A single typed flag with a default and an env-var override."""
+
+    def __init__(self, name: str, default: Any, help: str = "",
+                 parser: Callable[[str], Any] = str):
+        self.name = name
+        self.default = default
+        self.help = help
+        self.parser = parser
+        self._value = default
+        env = os.environ.get("SPARTAN_TPU_" + name.upper())
+        if env is not None:
+            self._value = parser(env)
+        # reset() restores the value as configured at definition time
+        # (env override included), not the compiled-in default.
+        self._initial = self._value
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @value.setter
+    def value(self, v: Any) -> None:
+        self._value = v
+
+    def parse(self, text: str) -> None:
+        self._value = self.parser(text)
+
+    def reset(self) -> None:
+        self._value = self._initial
+
+
+def _parse_bool(text: str) -> bool:
+    return text.lower() in ("1", "true", "yes", "on")
+
+
+def _parse_int_list(text: str) -> List[int]:
+    return [int(x) for x in text.split(",") if x]
+
+
+class FlagRegistry:
+    """Global registry; modules register flags at import time.
+
+    Access as attributes: ``FLAGS.opt_map_fusion``.
+    """
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_flags", {})
+        object.__setattr__(self, "_lock", threading.Lock())
+
+    def define(self, name: str, default: Any, help: str = "",
+               parser: Optional[Callable[[str], Any]] = None) -> Flag:
+        with self._lock:
+            if name in self._flags:
+                return self._flags[name]
+            if parser is None:
+                if isinstance(default, bool):
+                    parser = _parse_bool
+                elif isinstance(default, int):
+                    parser = int
+                elif isinstance(default, float):
+                    parser = float
+                else:
+                    parser = str
+            flag = Flag(name, default, help, parser)
+            self._flags[name] = flag
+            return flag
+
+    def define_bool(self, name: str, default: bool, help: str = "") -> Flag:
+        return self.define(name, default, help, _parse_bool)
+
+    def define_int(self, name: str, default: int, help: str = "") -> Flag:
+        return self.define(name, default, help, int)
+
+    def define_float(self, name: str, default: float, help: str = "") -> Flag:
+        return self.define(name, default, help, float)
+
+    def define_str(self, name: str, default: str, help: str = "") -> Flag:
+        return self.define(name, default, help, str)
+
+    def define_int_list(self, name: str, default: List[int],
+                        help: str = "") -> Flag:
+        return self.define(name, default, help, _parse_int_list)
+
+    def __getattr__(self, name: str) -> Any:
+        flags: Dict[str, Flag] = object.__getattribute__(self, "_flags")
+        if name in flags:
+            return flags[name].value
+        raise AttributeError(f"undefined flag: {name}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        flags: Dict[str, Flag] = object.__getattribute__(self, "_flags")
+        if name not in flags:
+            raise AttributeError(
+                f"undefined flag: {name}; call FLAGS.define() first")
+        flags[name].value = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._flags
+
+    def __iter__(self) -> Iterator[Flag]:
+        return iter(self._flags.values())
+
+    def parse_args(self, argv: Optional[List[str]] = None) -> List[str]:
+        """Parse ``--flag=value`` / ``--flag value`` CLI args; returns leftovers."""
+        parser = argparse.ArgumentParser(add_help=False)
+        for flag in self._flags.values():
+            parser.add_argument("--" + flag.name, type=str, default=None,
+                                help=flag.help)
+        ns, rest = parser.parse_known_args(argv)
+        for flag in self._flags.values():
+            text = getattr(ns, flag.name, None)
+            if text is not None:
+                flag.parse(text)
+        return rest
+
+    def reset_all(self) -> None:
+        for flag in self._flags.values():
+            flag.reset()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {f.name: f.value for f in self._flags.values()}
+
+
+FLAGS = FlagRegistry()
+
+# Core flags, registered up front so every subsystem can rely on them.
+FLAGS.define_bool("opt_map_fusion", True,
+                  "Fuse chained elementwise map exprs into one kernel.")
+FLAGS.define_bool("opt_reduce_fusion", True,
+                  "Fuse a map producer into a consuming reduce.")
+FLAGS.define_bool("opt_collapse_cached", True,
+                  "Collapse already-evaluated sub-DAGs into leaves.")
+FLAGS.define_bool("opt_auto_tiling", True,
+                  "Smart-tiling pass: pick shardings via the cost model.")
+FLAGS.define_bool("opt_fold_slices", True,
+                  "Fold slice-of-slice and slice-of-map expressions.")
+FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
+FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force().")
+FLAGS.define_str("profile_dir", "/tmp/spartan_tpu_profile",
+                 "Where profiler traces are written.")
+FLAGS.define_int("default_mesh_1d", 0,
+                 "If >0, force the default mesh to this many devices.")
+FLAGS.define_str("placement", "auto",
+                 "Tile placement strategy: auto|row|col|block|replicated")
+FLAGS.define_bool("check_determinism", False,
+                  "Debug mode: evaluate twice and assert bitwise equality.")
+FLAGS.define_bool("use_cpp_extent", True,
+                  "Use the C++ extent-algebra extension when built.")

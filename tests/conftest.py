@@ -1,0 +1,48 @@
+"""Test harness: the full mesh/collective path on 8 virtual CPU devices.
+
+The reference's test pattern was master + 3-4 workers as local subprocesses
+on localhost ZeroMQ (SURVEY.md §4); the TPU analogue is CPU JAX with
+``--xla_force_host_platform_device_count=8`` so every 'distributed' test
+runs multi-device on one machine.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the box presets axon (TPU); tests run CPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The box's site config re-forces JAX_PLATFORMS=axon; the config API wins.
+jax.config.update("jax_platforms", "cpu")
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh2d():
+    """4x2 (x, y) mesh over the 8 virtual devices, installed as ambient."""
+    from spartan_tpu.parallel import mesh as mesh_mod
+
+    m = mesh_mod.build_mesh(jax.devices(), shape=(4, 2))
+    with mesh_mod.use_mesh(m):
+        yield m
+
+
+@pytest.fixture()
+def mesh1d():
+    """8x1 mesh — pure row tiling."""
+    from spartan_tpu.parallel import mesh as mesh_mod
+
+    m = mesh_mod.build_mesh(jax.devices(), shape=(8, 1))
+    with mesh_mod.use_mesh(m):
+        yield m

@@ -1,0 +1,134 @@
+"""Pure unit tests for extent algebra (SURVEY.md §4: 'test_extent'-style —
+intersection, offset math; NumPy-free geometry)."""
+
+import numpy as np
+import pytest
+
+from spartan_tpu.array import extent
+from spartan_tpu.array.extent import TileExtent
+
+
+def test_basic_properties():
+    e = TileExtent((2, 3), (5, 7), (10, 10))
+    assert e.shape == (3, 4)
+    assert e.size == 12
+    assert e.ndim == 2
+    assert e.to_slice() == (slice(2, 5), slice(3, 7))
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        TileExtent((5,), (2,), (10,))
+    with pytest.raises(ValueError):
+        TileExtent((0,), (11,), (10,))
+    with pytest.raises(ValueError):
+        TileExtent((0, 0), (1,), (10, 10))
+
+
+def test_hash_eq():
+    a = TileExtent((0, 0), (2, 2), (4, 4))
+    b = TileExtent((0, 0), (2, 2), (4, 4))
+    c = TileExtent((0, 0), (2, 2), (8, 8))
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+    assert len({a, b, c}) == 2
+
+
+def test_intersection():
+    a = TileExtent((0, 0), (5, 5), (10, 10))
+    b = TileExtent((3, 3), (8, 8), (10, 10))
+    i = a.intersection(b)
+    assert i == TileExtent((3, 3), (5, 5), (10, 10))
+    # symmetric
+    assert b.intersection(a).ul == (3, 3)
+    # disjoint
+    c = TileExtent((5, 5), (10, 10), (10, 10))
+    assert a.intersection(c) is None
+    # touching edges are disjoint (half-open)
+    d = TileExtent((5, 0), (10, 5), (10, 10))
+    assert a.intersection(d) is None
+
+
+def test_offset_math():
+    outer = TileExtent((10, 20), (20, 40), (100, 100))
+    inner = TileExtent((12, 25), (15, 30), (100, 100))
+    local = inner.offset_from(outer)
+    assert local.ul == (2, 5) and local.lr == (5, 10)
+    assert outer.offset_slice(inner) == (slice(2, 5), slice(5, 10))
+    with pytest.raises(ValueError):
+        outer.offset_from(inner)
+    assert outer.to_global((0, 0)) == (10, 20)
+    assert outer.to_local((10, 20)) == (0, 0)
+
+
+def test_ravelled_pos_and_axes():
+    e = TileExtent((2, 3), (4, 5), (10, 10))
+    assert e.ravelled_pos() == 23
+    d = e.drop_axis(1)
+    assert d.ul == (2,) and d.lr == (4,) and d.array_shape == (10,)
+    a = d.add_axis(1, 5)
+    assert a.ul == (2, 0) and a.lr == (4, 5)
+
+
+def test_from_slice():
+    e = extent.from_slice((slice(1, 3), 4), (10, 10))
+    assert e.ul == (1, 4) and e.lr == (3, 5)
+    e = extent.from_slice(slice(None), (7, 3))
+    assert e.ul == (0, 0) and e.lr == (7, 3)
+    e = extent.from_slice((slice(-3, None),), (10,))
+    assert e.ul == (7,) and e.lr == (10,)
+    e = extent.from_slice(-1, (10,))
+    assert e.ul == (9,) and e.lr == (10,)
+    with pytest.raises(ValueError):
+        extent.from_slice(slice(0, 10, 2), (10,))
+    with pytest.raises(IndexError):
+        extent.from_slice((0, 0, 0), (10, 10))
+
+
+def test_compute_splits():
+    assert extent.compute_splits(10, 2) == [(0, 5), (5, 10)]
+    assert extent.compute_splits(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert extent.compute_splits(2, 5) == [(0, 1), (1, 2)]
+    # n splits capped at dim
+    assert len(extent.compute_splits(3, 8)) == 3
+
+
+def test_tile_grid_covers():
+    grid = extent.tile_grid((10, 12), (3, 2))
+    assert len(grid) == 6
+    assert extent.is_complete((10, 12), grid)
+    # row-major tile order
+    assert grid[0].ul == (0, 0)
+    assert grid[1].ul == (0, 6)
+    assert grid[2].ul == (4, 0)
+
+
+def test_tiles_like_hint():
+    grid = extent.tiles_like_hint((100, 100), (50, 100))
+    assert len(grid) == 2
+    assert grid[0].shape == (50, 100)
+    assert extent.is_complete((100, 100), grid)
+
+
+def test_find_overlapping():
+    grid = extent.tile_grid((10, 10), (2, 2))
+    region = TileExtent((4, 4), (6, 6), (10, 10))
+    hits = extent.find_overlapping(grid, region)
+    assert len(hits) == 4
+    region2 = TileExtent((0, 0), (5, 5), (10, 10))
+    assert extent.find_overlapping(grid, region2) == [grid[0]]
+
+
+def test_fetch_assembly_oracle():
+    """Assembling a region from grid tiles reproduces the NumPy slice —
+    the DistArray.fetch metadata path (SURVEY.md §3.5)."""
+    arr = np.arange(100).reshape(10, 10)
+    grid = extent.tile_grid((10, 10), (3, 3))
+    region = TileExtent((2, 3), (9, 8), (10, 10))
+    out = np.zeros(region.shape, arr.dtype)
+    for t in grid:
+        ix = t.intersection(region)
+        if ix is None:
+            continue
+        out[region.offset_slice(ix)] = arr[t.to_slice()][t.offset_slice(ix)]
+    np.testing.assert_array_equal(out, arr[region.to_slice()])

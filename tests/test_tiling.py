@@ -1,0 +1,93 @@
+"""Tiling vocabulary tests: Tiling <-> PartitionSpec <-> shard extents."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from spartan_tpu.array import extent, tiling
+from spartan_tpu.parallel import mesh as mesh_mod
+
+
+def test_canonical_tilings():
+    r = tiling.row(2)
+    assert r.spec() == P("x", None)
+    c = tiling.col(2)
+    assert c.spec() == P(None, "y")
+    b = tiling.block(2)
+    assert b.spec() == P("x", "y")
+    rep = tiling.replicated(3)
+    assert rep.spec() == P(None, None, None)
+    assert tiling.col(1) == tiling.replicated(1)
+
+
+def test_tiling_transforms():
+    b = tiling.block(3)
+    assert b.drop_axis(1).axes == ("x", None)
+    assert b.transpose((1, 0, 2)).axes == ("y", "x", None)
+    assert b.with_axis(2, "x").axes == ("x", "y", "x")
+    assert b.add_axis(0).axes == (None, "x", "y", None)
+
+
+def test_extents_on_mesh(mesh2d):
+    t = tiling.block(2)
+    exts = t.extents((8, 8))
+    assert len(exts) == 8  # 4x2 grid
+    assert extent.is_complete((8, 8), exts)
+    assert exts[0].shape == (2, 4)
+    r = tiling.row(2)
+    assert [e.shape for e in r.extents((8, 8))] == [(2, 8)] * 4
+
+
+def test_divisible(mesh2d):
+    assert tiling.block(2).divisible((8, 8))
+    assert not tiling.block(2).divisible((7, 8))
+    assert tiling.replicated(2).divisible((7, 13))
+
+
+def test_default_tiling(mesh2d):
+    # largest divisible axis gets the row axis
+    t = tiling.default_tiling((16, 6))
+    assert t.axes[0] == "x"
+    assert t.axes[1] == "y"
+    # indivisible dims stay unsharded
+    t2 = tiling.default_tiling((7, 13))
+    assert t2.axes == (None, None)
+
+
+def test_from_tile_hint(mesh2d):
+    t = tiling.from_tile_hint((100, 100), (25, 100))
+    assert t.axes == ("x", None)
+    t2 = tiling.from_tile_hint((100, 100), (25, 25))
+    assert t2.axes == ("x", "y")
+    t3 = tiling.from_tile_hint((100, 100), (100, 100))
+    assert t3.axes == (None, None)
+
+
+def test_sharding_placement(mesh2d):
+    """A sharded jax array's per-device shards match Tiling.extents —
+    'each Tile a device shard' (BASELINE.json:5)."""
+    import numpy as np
+
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    t = tiling.block(2)
+    sharded = jax.device_put(arr, t.sharding())
+    exts = t.extents((8, 8))
+    shard_index_set = {tuple(
+        (s.start or 0, s.stop or dim)
+        for s, dim in zip(shard.index, arr.shape))
+        for shard in sharded.addressable_shards}
+    ext_set = {tuple(zip(e.ul, e.lr)) for e in exts}
+    assert shard_index_set == ext_set
+
+
+def test_mesh_build_shapes():
+    devs = jax.devices()
+    m = mesh_mod.build_mesh(devs, shape=(2, 4))
+    assert m.shape["x"] == 2 and m.shape["y"] == 4
+    auto = mesh_mod.build_mesh(devs)
+    assert auto.shape["x"] * auto.shape["y"] == len(devs)
+
+
+def test_use_mesh_ctx(mesh1d):
+    m = mesh_mod.get_mesh()
+    assert m.shape["x"] == 8 and m.shape["y"] == 1
+    assert tiling.block(2).tiles_per_dim() == (8, 1)
