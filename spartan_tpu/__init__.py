@@ -1,0 +1,46 @@
+"""spartan_tpu: a TPU-native distributed N-d array framework.
+
+A brand-new JAX/XLA implementation of the capability surface of
+``sdutheone/spartan`` (see SURVEY.md): a lazy NumPy-like expression DAG
+(map / map2 / reduce / shuffle / outer / scan) over tile-partitioned
+distributed arrays — where a DistArray is a GSPMD-sharded ``jax.Array``,
+each tile is a device shard, expression forcing compiles the whole DAG
+into one XLA program, and shuffle/reduce lower to all-to-all/all-reduce
+collectives over ICI (BASELINE.json:5).
+
+Typical use::
+
+    import spartan_tpu as st
+    x = st.rand(4096, 4096)
+    y = ((x + x) * 3.0).sum()
+    print(y.glom())
+"""
+
+from .array import distarray as _da
+from .array.distarray import DistArray
+from .array.extent import TileExtent
+from .array.tiling import Tiling
+from .expr import *  # noqa: F401,F403
+from .expr import __all__ as _expr_all
+from .parallel import mesh as _mesh
+from .parallel.mesh import build_mesh, get_mesh, set_mesh, use_mesh
+from .utils.config import FLAGS
+
+__version__ = "0.1.0"
+
+__all__ = (["DistArray", "TileExtent", "Tiling", "FLAGS", "build_mesh",
+            "get_mesh", "set_mesh", "use_mesh", "initialize", "shutdown"]
+           + list(_expr_all))
+
+
+def initialize(argv=None):
+    """Parity with the reference's ``spartan.initialize()`` (SURVEY.md
+    §3.1): parse flags and install the ambient context. The whole
+    master/worker bring-up collapses to mesh construction."""
+    rest = FLAGS.parse_args(argv)
+    _mesh.get_mesh()
+    return rest
+
+
+def shutdown():
+    _mesh.set_mesh(None)

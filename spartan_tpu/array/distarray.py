@@ -1,0 +1,273 @@
+"""DistArray: a tile-partitioned distributed N-d array as a sharded jax.Array.
+
+Capability parity with the reference's distributed array layer (SURVEY.md
+§2.2: ``[U] spartan/array/distarray.py`` — tile map, ``create``, ``fetch``,
+``update``, ``foreach_tile``, ``glom``, broadcast wrapper). Re-designed
+TPU-first per BASELINE.json:5: *"DistArray tiling becomes a GSPMD
+NamedSharding over a TPU mesh, with each Tile a device shard"*. There is no
+tile store, no placement RPC and no per-tile locking: the array IS a
+``jax.Array`` whose sharding is described by a :class:`Tiling`; the tile map
+of the reference is recoverable as ``self.extents()``. All mutation-flavored
+APIs (``update``) are functional — they return a new DistArray (SURVEY.md §7
+hard part 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..parallel import mesh as mesh_mod
+from . import extent as extent_mod
+from . import tiling as tiling_mod
+from .extent import TileExtent
+from .tiling import Tiling
+
+# Reducers for update(): name -> (jnp combine, at[].op name)
+REDUCERS = {
+    None: "set",
+    "set": "set",
+    "add": "add",
+    "mul": "multiply",
+    "max": "max",
+    "min": "min",
+}
+
+
+def _canonical_reducer(reducer: Any) -> str:
+    """Accept the reference's np-function reducers as well as names."""
+    if reducer is None:
+        return "set"
+    if isinstance(reducer, str):
+        if reducer not in REDUCERS:
+            raise ValueError(f"unknown reducer {reducer!r}")
+        return reducer
+    for name, fn in (("add", np.add), ("mul", np.multiply),
+                     ("max", np.maximum), ("min", np.minimum)):
+        if reducer is fn:
+            return name
+    raise ValueError(f"unsupported reducer {reducer!r}; use one of "
+                     f"{sorted(k for k in REDUCERS if k)}")
+
+
+class DistArray:
+    """A distributed N-d array: ``jax.Array`` + :class:`Tiling` over the
+    ambient mesh."""
+
+    __slots__ = ("jax_array", "tiling", "mesh")
+
+    def __init__(self, jax_array: jax.Array, tiling: Tiling,
+                 mesh: Optional[Mesh] = None):
+        if tiling.ndim != jax_array.ndim:
+            raise ValueError(
+                f"tiling rank {tiling.ndim} != array rank {jax_array.ndim}")
+        self.jax_array = jax_array
+        self.tiling = tiling
+        self.mesh = mesh or mesh_mod.get_mesh()
+
+    # -- basic properties ----------------------------------------------
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self.jax_array.shape)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self.jax_array.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return self.jax_array.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self.jax_array.size)
+
+    def __repr__(self) -> str:
+        return (f"DistArray(shape={self.shape}, dtype={self.dtype}, "
+                f"tiling={self.tiling})")
+
+    def sharding(self) -> NamedSharding:
+        return self.tiling.sharding(self.mesh)
+
+    # -- tile map view (the reference's {TileExtent -> TileId}) ---------
+
+    def extents(self) -> List[TileExtent]:
+        return self.tiling.extents(self.shape, self.mesh)
+
+    def tile_shape(self) -> tuple:
+        """Shape of the largest shard."""
+        exts = self.extents()
+        return max((e.shape for e in exts), key=lambda s: np.prod(s or (1,)))
+
+    # -- data access ----------------------------------------------------
+
+    def glom(self) -> np.ndarray:
+        """Fetch the whole array to the host (the reference's ``glom``)."""
+        return np.asarray(jax.device_get(self.jax_array))
+
+    def fetch(self, region: Union[TileExtent, tuple, slice, int]
+              ) -> np.ndarray:
+        """Fetch an arbitrary rectangular region to the host.
+
+        The reference assembled this from per-tile RPCs (SURVEY.md §3.5);
+        here XLA slices the sharded array and gathers the result.
+        """
+        if not isinstance(region, TileExtent):
+            region = extent_mod.from_slice(region, self.shape)
+        sl = region.to_slice()
+        return np.asarray(jax.device_get(self.jax_array[sl]))
+
+    def update(self, region: Union[TileExtent, tuple, slice],
+               data: Any, reducer: Any = None) -> "DistArray":
+        """Functional region write: a new DistArray whose ``region`` holds
+        ``reducer(existing, data)`` (default: overwrite).
+
+        The reference's ``update(extent, data, reducer)`` mutated tiles
+        through worker RPCs with reducer-merge (SURVEY.md §2.2); here it is
+        a functional scatter-combine, deterministic by construction
+        (SURVEY.md §7 hard part 3).
+        """
+        if not isinstance(region, TileExtent):
+            region = extent_mod.from_slice(region, self.shape)
+        op = _canonical_reducer(reducer)
+        data = jnp.asarray(data, dtype=self.dtype)
+        if data.shape != region.shape:
+            data = jnp.broadcast_to(data, region.shape)
+        sl = region.to_slice()
+
+        def _apply(x, d):
+            ref = x.at[sl]
+            return getattr(ref, op)(d)
+
+        out = jax.jit(_apply, out_shardings=self.sharding())(
+            self.jax_array, data)
+        return DistArray(out, self.tiling, self.mesh)
+
+    # -- resharding -----------------------------------------------------
+
+    def retile(self, new_tiling: Tiling) -> "DistArray":
+        """Redistribute to a new tiling. XLA emits the minimal collective
+        (all-to-all / all-gather over ICI) — the lowering of the
+        reference's shuffle-based redistribution (SURVEY.md §2.6)."""
+        if new_tiling == self.tiling:
+            return self
+        arr = jax.device_put(self.jax_array, new_tiling.sharding(self.mesh))
+        return DistArray(arr, new_tiling, self.mesh)
+
+    def replicate(self) -> "DistArray":
+        return self.retile(tiling_mod.replicated(self.ndim))
+
+    # -- per-shard execution (the foreach_tile analogue) ----------------
+
+    def map_shards(self, fn: Callable[[jax.Array], jax.Array]
+                   ) -> "DistArray":
+        """Apply a shape-preserving jax-traceable fn to every shard
+        independently (owner-computes, no communication) — the analogue of
+        ``foreach_tile`` (SURVEY.md §2.2) for traceable kernels."""
+        from jax import shard_map
+
+        spec = self.tiling.spec()
+        mapped = shard_map(fn, mesh=self.mesh, in_specs=(spec,),
+                           out_specs=spec)
+        out = jax.jit(mapped)(self.jax_array)
+        return DistArray(out, self.tiling, self.mesh)
+
+
+# -- creation -----------------------------------------------------------
+
+
+def _resolve_tiling(shape: Sequence[int], tiling: Optional[Tiling],
+                    tile_hint: Optional[Sequence[int]],
+                    mesh: Optional[Mesh]) -> Tiling:
+    if tiling is not None:
+        return tiling
+    if tile_hint is not None:
+        return tiling_mod.from_tile_hint(shape, tile_hint, mesh)
+    return tiling_mod.default_tiling(shape, mesh)
+
+
+def from_numpy(arr: Any, tiling: Optional[Tiling] = None,
+               tile_hint: Optional[Sequence[int]] = None,
+               mesh: Optional[Mesh] = None) -> DistArray:
+    arr = np.asarray(arr)
+    mesh = mesh or mesh_mod.get_mesh()
+    t = _resolve_tiling(arr.shape, tiling, tile_hint, mesh)
+    jarr = jax.device_put(arr, t.sharding(mesh))
+    return DistArray(jarr, t, mesh)
+
+
+def from_jax(arr: jax.Array, tiling: Optional[Tiling] = None,
+             mesh: Optional[Mesh] = None) -> DistArray:
+    mesh = mesh or mesh_mod.get_mesh()
+    if tiling is None:
+        spec = (arr.sharding.spec if isinstance(arr.sharding, NamedSharding)
+                else None)
+        tiling = (tiling_mod.spec_to_tiling(spec, arr.ndim) if spec is not None
+                  else tiling_mod.replicated(arr.ndim))
+    return DistArray(arr, tiling, mesh)
+
+
+def _filled(shape: Sequence[int], dtype: Any, fill: Callable[..., jax.Array],
+            tiling: Optional[Tiling], tile_hint: Optional[Sequence[int]],
+            mesh: Optional[Mesh]) -> DistArray:
+    shape = tuple(int(s) for s in shape)
+    mesh = mesh or mesh_mod.get_mesh()
+    t = _resolve_tiling(shape, tiling, tile_hint, mesh)
+    make = jax.jit(fill, static_argnums=(), out_shardings=t.sharding(mesh))
+    return DistArray(make(), t, mesh)
+
+
+def zeros(shape: Sequence[int], dtype: Any = np.float32,
+          tiling: Optional[Tiling] = None,
+          tile_hint: Optional[Sequence[int]] = None,
+          mesh: Optional[Mesh] = None) -> DistArray:
+    return _filled(shape, dtype, lambda: jnp.zeros(shape, dtype),
+                   tiling, tile_hint, mesh)
+
+
+def ones(shape: Sequence[int], dtype: Any = np.float32,
+         tiling: Optional[Tiling] = None,
+         tile_hint: Optional[Sequence[int]] = None,
+         mesh: Optional[Mesh] = None) -> DistArray:
+    return _filled(shape, dtype, lambda: jnp.ones(shape, dtype),
+                   tiling, tile_hint, mesh)
+
+
+def full(shape: Sequence[int], fill_value: Any, dtype: Any = None,
+         tiling: Optional[Tiling] = None,
+         tile_hint: Optional[Sequence[int]] = None,
+         mesh: Optional[Mesh] = None) -> DistArray:
+    return _filled(shape, dtype, lambda: jnp.full(shape, fill_value, dtype),
+                   tiling, tile_hint, mesh)
+
+
+def arange(*args, dtype: Any = None, tiling: Optional[Tiling] = None,
+           tile_hint: Optional[Sequence[int]] = None,
+           mesh: Optional[Mesh] = None) -> DistArray:
+    probe = np.arange(*args, dtype=dtype)
+    return _filled(probe.shape, probe.dtype,
+                   lambda: jnp.arange(*args, dtype=dtype),
+                   tiling, tile_hint, mesh)
+
+
+def rand(*shape: int, seed: int = 0, tiling: Optional[Tiling] = None,
+         tile_hint: Optional[Sequence[int]] = None,
+         mesh: Optional[Mesh] = None) -> DistArray:
+    key = jax.random.key(seed)
+    return _filled(shape, np.float32,
+                   lambda: jax.random.uniform(key, shape, jnp.float32),
+                   tiling, tile_hint, mesh)
+
+
+def randn(*shape: int, seed: int = 0, tiling: Optional[Tiling] = None,
+          tile_hint: Optional[Sequence[int]] = None,
+          mesh: Optional[Mesh] = None) -> DistArray:
+    key = jax.random.key(seed)
+    return _filled(shape, np.float32,
+                   lambda: jax.random.normal(key, shape, jnp.float32),
+                   tiling, tile_hint, mesh)

@@ -1,0 +1,15 @@
+"""Public lazy-expression API (the reference's ``spartan.expr`` surface)."""
+
+from .base import (Expr, ScalarExpr, ValExpr, as_expr, clear_compile_cache,
+                   compile_cache_size, evaluate, lazify)
+from .builtins import *  # noqa: F401,F403
+from .builtins import __all__ as _builtin_all
+from .map import MapExpr, map, map_with_location
+from .ndarray import CreateExpr, RandomExpr
+from .optimize import dag_nodes, optimize
+from .reduce import GeneralReduceExpr, ReduceExpr
+
+__all__ = ["Expr", "ValExpr", "ScalarExpr", "as_expr", "lazify", "evaluate",
+           "optimize", "dag_nodes", "map", "map_with_location", "MapExpr",
+           "ReduceExpr", "GeneralReduceExpr", "CreateExpr", "RandomExpr",
+           "compile_cache_size", "clear_compile_cache"] + list(_builtin_all)

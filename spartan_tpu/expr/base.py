@@ -1,0 +1,486 @@
+"""Lazy expression DAG over DistArrays, evaluated as ONE jitted XLA program.
+
+Parity with the reference's expr layer (SURVEY.md §2.3: ``[U]
+spartan/expr/base.py`` — ``Expr`` node with unique id, children,
+``evaluate()`` with DAG-level memo cache, ``force``, ``glom``, operator
+overloading, ``Val``/``AsArray`` wrappers). The execution model is the
+re-design mandated by BASELINE.json:5: instead of shipping per-tile kernels
+over RPC, ``force()`` lowers the whole DAG into a single traced function
+over the leaf arrays and jit-compiles it with GSPMD out-shardings — the
+expr DAG -> jaxpr boundary replaces the expr -> per-tile-kernel boundary
+(SURVEY.md §3.2). Compiled executables are cached by DAG structure, so
+iterative drivers (k-means, SGD) hit the cache every step.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import distarray as da
+from ..array import tiling as tiling_mod
+from ..array.distarray import DistArray
+from ..array.tiling import Tiling
+from ..parallel import mesh as mesh_mod
+from ..utils.config import FLAGS
+from ..utils.log import log_debug
+
+_ids = itertools.count()
+
+
+class Expr:
+    """A node in the lazy DAG. Subclasses define children + lowering."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype: Any):
+        self._id = next(_ids)
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = np.dtype(dtype)
+        self._result: Optional[DistArray] = None
+        self._forced_tiling: Optional[Tiling] = None
+
+    # -- structure ------------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    def children(self) -> Tuple["Expr", ...]:
+        raise NotImplementedError
+
+    def replace_children(self, new_children: Tuple["Expr", ...]) -> "Expr":
+        """Clone this node over rewritten children (optimizer passes)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support replace_children")
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        """Emit the traced jnp value for this node (children already in
+        env is NOT guaranteed — call self.lower on children)."""
+        raise NotImplementedError
+
+    def lower(self, env: Dict[int, Any]) -> Any:
+        if self._id not in env:
+            env[self._id] = self._lower(env)
+        return env[self._id]
+
+    def _sig(self, ctx: "_SigCtx") -> Tuple:
+        """Structural signature of this node (children via ctx.of)."""
+        raise NotImplementedError
+
+    def out_tiling(self) -> Tiling:
+        """Sharding of the evaluated result (overridable by the
+        auto-tiling pass via ``_forced_tiling``)."""
+        if self._forced_tiling is not None:
+            return self._forced_tiling
+        return self._default_tiling()
+
+    def _default_tiling(self) -> Tiling:
+        raise NotImplementedError
+
+    # -- evaluation -----------------------------------------------------
+
+    def evaluate(self) -> DistArray:
+        return evaluate(self)
+
+    def force(self) -> DistArray:
+        return evaluate(self)
+
+    def optimized(self) -> "Expr":
+        from .optimize import optimize
+
+        return optimize(self)
+
+    def glom(self) -> np.ndarray:
+        out = evaluate(self).glom()
+        return out
+
+    def __array__(self, dtype=None):
+        out = self.glom()
+        return out.astype(dtype) if dtype is not None else out
+
+    # -- operator overloading (build MapExprs) --------------------------
+
+    def _binop(self, other: Any, name: str, reverse: bool = False) -> "Expr":
+        from .map import build_binop
+
+        return build_binop(name, self, other, reverse)
+
+    def __add__(self, o):
+        return self._binop(o, "add")
+
+    def __radd__(self, o):
+        return self._binop(o, "add", True)
+
+    def __sub__(self, o):
+        return self._binop(o, "subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, "subtract", True)
+
+    def __mul__(self, o):
+        return self._binop(o, "multiply")
+
+    def __rmul__(self, o):
+        return self._binop(o, "multiply", True)
+
+    def __truediv__(self, o):
+        return self._binop(o, "divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "divide", True)
+
+    def __floordiv__(self, o):
+        return self._binop(o, "floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._binop(o, "floor_divide", True)
+
+    def __mod__(self, o):
+        return self._binop(o, "mod")
+
+    def __rmod__(self, o):
+        return self._binop(o, "mod", True)
+
+    def __pow__(self, o):
+        return self._binop(o, "power")
+
+    def __rpow__(self, o):
+        return self._binop(o, "power", True)
+
+    def __neg__(self):
+        from .map import build_unop
+
+        return build_unop("negative", self)
+
+    def __abs__(self):
+        from .map import build_unop
+
+        return build_unop("absolute", self)
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._binop(o, "equal")
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._binop(o, "not_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "less")
+
+    def __le__(self, o):
+        return self._binop(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "greater")
+
+    def __ge__(self, o):
+        return self._binop(o, "greater_equal")
+
+    def __and__(self, o):
+        return self._binop(o, "bitwise_and")
+
+    def __or__(self, o):
+        return self._binop(o, "bitwise_or")
+
+    def __xor__(self, o):
+        return self._binop(o, "bitwise_xor")
+
+    def __hash__(self) -> int:  # __eq__ is overloaded; hash by identity
+        return id(self)
+
+    def __bool__(self) -> bool:
+        # NumPy semantics: only size-1 results truth-test (forces eval).
+        if self.size != 1:
+            raise TypeError(
+                "truth value of a multi-element Expr is ambiguous; "
+                "use .any()/.all()")
+        return bool(self.glom().reshape(()))
+
+    def __getitem__(self, idx) -> "Expr":
+        from .slice import make_slice
+
+        return make_slice(self, idx)
+
+    # -- numpy-flavoured conveniences ------------------------------------
+
+    def astype(self, dtype) -> "Expr":
+        from .builtins import astype
+
+        return astype(self, dtype)
+
+    def sum(self, axis=None, keepdims=False) -> "Expr":
+        from .reduce import sum as _sum
+
+        return _sum(self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False) -> "Expr":
+        from .reduce import mean
+
+        return mean(self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False) -> "Expr":
+        from .reduce import max as _max
+
+        return _max(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False) -> "Expr":
+        from .reduce import min as _min
+
+        return _min(self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None) -> "Expr":
+        from .reduce import argmax
+
+        return argmax(self, axis=axis)
+
+    def argmin(self, axis=None) -> "Expr":
+        from .reduce import argmin
+
+        return argmin(self, axis=axis)
+
+    def all(self, axis=None, keepdims=False) -> "Expr":
+        from .reduce import all as _all
+
+        return _all(self, axis=axis, keepdims=keepdims)
+
+    def any(self, axis=None, keepdims=False) -> "Expr":
+        from .reduce import any as _any
+
+        return _any(self, axis=axis, keepdims=keepdims)
+
+    def dot(self, other) -> "Expr":
+        from .dot import dot
+
+        return dot(self, other)
+
+    def transpose(self, *axes) -> "Expr":
+        from .reshape import transpose
+
+        return transpose(self, *axes)
+
+    @property
+    def T(self) -> "Expr":
+        return self.transpose()
+
+    def reshape(self, *shape) -> "Expr":
+        from .reshape import reshape
+
+        return reshape(self, *shape)
+
+    def ravel(self) -> "Expr":
+        from .reshape import ravel
+
+        return ravel(self)
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(id={self._id}, shape={self._shape}, "
+                f"dtype={self._dtype})")
+
+
+# -- leaf nodes ---------------------------------------------------------
+
+
+class ValExpr(Expr):
+    """Leaf wrapping an evaluated DistArray (the reference's ``Val``)."""
+
+    def __init__(self, value: DistArray):
+        super().__init__(value.shape, value.dtype)
+        self.value = value
+        self._result = value
+
+    def children(self) -> Tuple[Expr, ...]:
+        return ()
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> Expr:
+        return self
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        raise RuntimeError("leaf must be seeded into env before lowering")
+
+    def _sig(self, ctx: "_SigCtx") -> Tuple:
+        return ("val", ctx.leaf_pos(self), self._shape, str(self._dtype),
+                self.value.tiling.axes)
+
+    def _default_tiling(self) -> Tiling:
+        return self.value.tiling
+
+
+class ScalarExpr(Expr):
+    """Leaf wrapping a Python scalar, passed as a (weakly-typed) traced
+    argument so iterative drivers don't recompile when it changes."""
+
+    def __init__(self, value: Any):
+        dtype = np.result_type(type(value))
+        super().__init__((), dtype)
+        self.pyvalue = value
+        self.weak_kind = ("b" if isinstance(value, bool) else
+                          "i" if isinstance(value, int) else "f")
+
+    def children(self) -> Tuple[Expr, ...]:
+        return ()
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> Expr:
+        return self
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        raise RuntimeError("leaf must be seeded into env before lowering")
+
+    def _sig(self, ctx: "_SigCtx") -> Tuple:
+        # value intentionally NOT in the signature: same-structure DAGs with
+        # different scalar constants share one executable.
+        return ("scalar", ctx.leaf_pos(self), self.weak_kind)
+
+    def _default_tiling(self) -> Tiling:
+        return tiling_mod.replicated(0)
+
+
+def as_expr(value: Any) -> Expr:
+    """The reference's ``AsArray``: coerce anything to an Expr."""
+    if isinstance(value, Expr):
+        return value
+    if isinstance(value, DistArray):
+        return ValExpr(value)
+    if isinstance(value, (bool, int, float, np.bool_, np.integer,
+                          np.floating)):
+        if isinstance(value, (np.bool_, np.integer, np.floating)):
+            value = value.item()
+        return ScalarExpr(value)
+    if isinstance(value, (np.ndarray, list, tuple)):
+        return ValExpr(da.from_numpy(np.asarray(value)))
+    if isinstance(value, jax.Array):
+        return ValExpr(da.from_jax(value))
+    raise TypeError(f"cannot lift {type(value).__name__} into an Expr")
+
+
+def lazify(value: Any) -> Expr:
+    return as_expr(value)
+
+
+# -- evaluation machinery ----------------------------------------------
+
+
+class _SigCtx:
+    """Assigns stable positions to leaves and dedups shared subtrees."""
+
+    def __init__(self) -> None:
+        self.leaves: List[Expr] = []
+        self._leaf_pos: Dict[int, int] = {}
+        self._memo: Dict[int, Tuple] = {}
+        self._visit: Dict[int, int] = {}
+
+    def leaf_pos(self, leaf: Expr) -> int:
+        pos = self._leaf_pos.get(leaf._id)
+        if pos is None:
+            pos = len(self.leaves)
+            self._leaf_pos[leaf._id] = pos
+            self.leaves.append(leaf)
+        return pos
+
+    def of(self, node: Expr) -> Tuple:
+        if node._id in self._memo:
+            # shared subtree: refer to it by visit index, not structure,
+            # so diamond DAGs don't blow up exponentially
+            return ("ref", self._visit[node._id])
+        sig = node._sig(self)
+        self._visit[node._id] = len(self._memo)
+        self._memo[node._id] = sig
+        return sig
+
+
+_compile_cache: Dict[Tuple, Callable] = {}
+_cache_lock = threading.Lock()
+
+
+def compile_cache_size() -> int:
+    return len(_compile_cache)
+
+
+def clear_compile_cache() -> None:
+    with _cache_lock:
+        _compile_cache.clear()
+
+
+def _leaf_arg(leaf: Expr) -> Any:
+    if isinstance(leaf, ValExpr):
+        return leaf.value.jax_array
+    if isinstance(leaf, ScalarExpr):
+        return leaf.pyvalue
+    raise TypeError(f"unknown leaf {leaf!r}")
+
+
+def evaluate(expr: Expr) -> DistArray:
+    """Evaluate one root: optimize -> signature -> (cached) jit -> run."""
+    if expr._result is not None:
+        return expr._result
+
+    from .optimize import optimize
+
+    dag = optimize(expr)
+    if dag._result is not None:
+        expr._result = dag._result
+        return dag._result
+
+    ctx = _SigCtx()
+    root_sig = ctx.of(dag)
+    leaves = ctx.leaves
+    out_tiling = dag.out_tiling()
+    mesh = mesh_mod.get_mesh()
+    key = (root_sig, out_tiling.axes,
+           tuple(sorted(mesh.shape.items())))
+
+    with _cache_lock:
+        jitted = _compile_cache.get(key)
+    if jitted is None:
+        leaf_ids = tuple(l._id for l in leaves)
+
+        def traced(*args: Any) -> Any:
+            env: Dict[int, Any] = dict(zip(leaf_ids, args))
+            return dag.lower(env)
+
+        jitted = jax.jit(traced, out_shardings=out_tiling.sharding(mesh))
+        with _cache_lock:
+            _compile_cache[key] = jitted
+        log_debug("compiled expr dag sig=%s", hash(key))
+    else:
+        # cached executable closes over ITS dag's leaf ids; reseed by
+        # position, which the signature guarantees to match
+        pass
+
+    args = [_leaf_arg(l) for l in leaves]
+    out = jitted(*args)
+    result = DistArray(out, out_tiling, mesh)
+
+    if FLAGS.check_determinism:
+        out2 = jitted(*args)
+        if not bool(jnp.all(out == out2)):
+            raise AssertionError("nondeterministic evaluation detected")
+
+    expr._result = result
+    dag._result = result
+    return result
+
+
+def eval_shape_of(fn: Callable, *inputs: Expr, **kw) -> jax.ShapeDtypeStruct:
+    """Exact result shape/dtype via abstract evaluation (no FLOPs)."""
+    specs = []
+    for i in inputs:
+        if isinstance(i, ScalarExpr):
+            specs.append(i.pyvalue)
+        else:
+            specs.append(jax.ShapeDtypeStruct(i.shape, i.dtype))
+    return jax.eval_shape(fn, *specs, **kw)

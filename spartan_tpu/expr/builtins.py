@@ -1,0 +1,254 @@
+"""NumPy-surface builtins over the expr DAG.
+
+Parity with ``[U] spartan/expr/builtins.py`` (SURVEY.md §2.3: ``zeros ones
+rand randn arange astype ravel sum mean max min argmin argmax diag diagonal
+norm concatenate bincount tril triu scan``) — mostly thin wrappers over
+map/reduce/creation exprs, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import distarray as da
+from .base import Expr, ScalarExpr, ValExpr, as_expr
+from .map import MapExpr, build_unop, map as map_expr
+from .ndarray import CreateExpr, RandomExpr, ndarray
+from .reduce import (all, any, argmax, argmin, max, mean, min, prod,
+                     reduce, sum)
+
+__all__ = [
+    "zeros", "ones", "full", "arange", "eye", "identity", "rand", "randn",
+    "randint", "astype", "absolute", "exp", "log", "sqrt", "square", "abs",
+    "sign", "sin", "cos", "tan", "tanh", "maximum", "minimum", "where",
+    "clip", "sum", "mean", "max", "min", "prod", "all", "any", "argmax",
+    "argmin", "reduce", "ndarray", "norm", "diag", "diagonal", "tril",
+    "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
+    "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
+]
+
+
+# -- creation -----------------------------------------------------------
+
+
+def zeros(shape, dtype=np.float32, tile_hint=None, tiling=None) -> Expr:
+    return CreateExpr(shape, dtype, "zeros", (), tiling, tile_hint)
+
+
+def ones(shape, dtype=np.float32, tile_hint=None, tiling=None) -> Expr:
+    return CreateExpr(shape, dtype, "ones", (), tiling, tile_hint)
+
+
+def full(shape, fill_value, dtype=np.float32, tile_hint=None,
+         tiling=None) -> Expr:
+    return CreateExpr(shape, dtype, "full", (fill_value,), tiling, tile_hint)
+
+
+def arange(*args, dtype=None, tile_hint=None, tiling=None) -> Expr:
+    probe = np.arange(*args, dtype=dtype)
+    if probe.dtype == np.float64:
+        probe = probe.astype(np.float32)
+    if probe.dtype == np.int64:
+        probe = probe.astype(np.int32)
+    return CreateExpr(probe.shape, probe.dtype, "arange", tuple(args),
+                      tiling, tile_hint)
+
+
+def eye(n, m=None, k=0, dtype=np.float32, tile_hint=None) -> Expr:
+    m = n if m is None else m
+    return CreateExpr((n, m), dtype, "eye", (n, m, k), None, tile_hint)
+
+
+def identity(n, dtype=np.float32) -> Expr:
+    return eye(n, dtype=dtype)
+
+
+def rand(*shape, seed=None, tile_hint=None, tiling=None) -> Expr:
+    return RandomExpr(shape, "uniform", seed, np.float32, tiling, tile_hint)
+
+
+def randn(*shape, seed=None, tile_hint=None, tiling=None) -> Expr:
+    return RandomExpr(shape, "normal", seed, np.float32, tiling, tile_hint)
+
+
+def randint(*shape, low=0, high=10, seed=None, tile_hint=None) -> Expr:
+    e = RandomExpr(shape, "randint", seed, np.int32, None, tile_hint)
+    e.params_range = (low, high)
+    return e
+
+
+def from_numpy(arr, tiling=None, tile_hint=None) -> Expr:
+    return ValExpr(da.from_numpy(arr, tiling=tiling, tile_hint=tile_hint))
+
+
+# -- elementwise wrappers ----------------------------------------------
+
+
+def _unary(name):
+    def fn(x) -> Expr:
+        return build_unop(name, x)
+
+    fn.__name__ = name
+    return fn
+
+
+absolute = _unary("absolute")
+abs = absolute
+exp = _unary("exp")
+log = _unary("log")
+sqrt = _unary("sqrt")
+square = _unary("square")
+sign = _unary("sign")
+sin = _unary("sin")
+cos = _unary("cos")
+tan = _unary("tan")
+tanh = _unary("tanh")
+
+
+def maximum(a, b) -> Expr:
+    from .map import build_binop
+
+    return build_binop("maximum", a, b)
+
+
+def minimum(a, b) -> Expr:
+    from .map import build_binop
+
+    return build_binop("minimum", a, b)
+
+
+def power(a, b) -> Expr:
+    from .map import build_binop
+
+    return build_binop("power", a, b)
+
+
+def equal(a, b) -> Expr:
+    from .map import build_binop
+
+    return build_binop("equal", a, b)
+
+
+def where(cond, a, b) -> Expr:
+    from .local import LocalInput, LocalUfunc
+
+    inputs = (as_expr(cond), as_expr(a), as_expr(b))
+    return MapExpr(inputs, LocalUfunc(
+        "where", (LocalInput(0), LocalInput(1), LocalInput(2))))
+
+
+def clip(x, lo, hi) -> Expr:
+    from .local import LocalInput, LocalUfunc
+
+    inputs = (as_expr(x), as_expr(lo), as_expr(hi))
+    return MapExpr(inputs, LocalUfunc(
+        "clip", (LocalInput(0), LocalInput(1), LocalInput(2))))
+
+
+def astype(x, dtype) -> Expr:
+    dtype = np.dtype(dtype)
+    return map_expr(lambda v: v.astype(dtype), as_expr(x))
+
+
+# -- shape-flavoured / misc builtins -----------------------------------
+
+
+def ravel(x) -> Expr:
+    from .reshape import ravel as _ravel
+
+    return _ravel(x)
+
+
+def concatenate(arrays, axis=0) -> Expr:
+    from .reshape import concatenate as _concat
+
+    return _concat(arrays, axis)
+
+
+def dot(a, b) -> Expr:
+    from .dot import dot as _dot
+
+    return _dot(a, b)
+
+
+def norm(x, ord=2) -> Expr:
+    x = as_expr(x)
+    if ord == 2:
+        return sqrt(sum(x * x))
+    if ord == 1:
+        return sum(absolute(x))
+    raise ValueError(f"unsupported norm order {ord}")
+
+
+def diag(x) -> Expr:
+    """1-D -> diagonal matrix; 2-D -> its diagonal (NumPy semantics)."""
+    x = as_expr(x)
+    if x.ndim == 1:
+        return map_expr(lambda v: jnp.diag(v), x)
+    if x.ndim == 2:
+        return diagonal(x)
+    raise ValueError("diag requires 1-D or 2-D input")
+
+
+def diagonal(x) -> Expr:
+    x = as_expr(x)
+    if x.ndim != 2:
+        raise ValueError("diagonal requires a 2-D input")
+    from .map import MapExpr
+    from .local import LocalCall, LocalInput
+
+    return MapExpr((x,), LocalCall(jnp.diagonal, (LocalInput(0),)))
+
+
+def tril(x, k=0) -> Expr:
+    return map_expr(lambda v: jnp.tril(v, k), as_expr(x))
+
+
+def triu(x, k=0) -> Expr:
+    return map_expr(lambda v: jnp.triu(v, k), as_expr(x))
+
+
+def bincount(x, minlength: Optional[int] = None,
+             length: Optional[int] = None) -> Expr:
+    """Counts of nonnegative ints. A static ``length``/``minlength`` keeps
+    the output shape static for XLA (dynamic shapes are TPU-hostile); it
+    defaults to ``x.max()+1`` computed eagerly (one small collective)."""
+    x = as_expr(x)
+    n = length or minlength
+    if n is None:
+        n = int(max(x).glom()) + 1
+    return map_expr(lambda v: jnp.bincount(v.ravel(), length=n), x)
+
+
+def count_nonzero(x) -> Expr:
+    x = as_expr(x)
+    return sum(astype(x != 0, np.int32))
+
+
+def count_zero(x) -> Expr:
+    x = as_expr(x)
+    return sum(astype(x == 0, np.int32))
+
+
+def size(x) -> int:
+    return as_expr(x).size
+
+
+def scan(x, axis: int = 0, op: str = "add") -> Expr:
+    """Prefix scan along an axis (exercised by SSVD per BASELINE.json:11).
+
+    Lowered to ``jnp.cumsum``-family ops, which XLA implements with a
+    work-efficient parallel scan (log-depth over the sharded axis)."""
+    fns = {"add": jnp.cumsum, "mul": jnp.cumprod,
+           "max": lambda v, axis: jax.lax.cummax(v, axis=axis),
+           "min": lambda v, axis: jax.lax.cummin(v, axis=axis)}
+    if op not in fns:
+        raise ValueError(f"unknown scan op {op!r}")
+    fn = fns[op]
+    return map_expr(lambda v: fn(v, axis=axis), as_expr(x))
+
+
+import jax  # noqa: E402  (used inside scan closures)

@@ -1,0 +1,230 @@
+"""Local (fused per-shard) expression trees — the map-fusion unit.
+
+Parity with the reference's inner DAG (SURVEY.md §2.3: ``[U]
+spartan/expr/local.py`` — ``LocalInput``/``LocalMapExpr``/``FnCallExpr``,
+"what map-fusion fuses"). In the reference a fused local tree was executed
+by NumPy (or Parakeet-JITted) inside one tile kernel; here it is *traced*
+into the enclosing XLA computation, so fusion serves to (a) keep the expr
+DAG small, (b) preserve the reference's optimizer-pass API, while XLA does
+the actual loop fusion on the MXU/VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# ufunc registry: name -> (jnp fn, numpy oracle fn, arity)
+UFUNCS: Dict[str, Tuple[Callable, Callable, int]] = {
+    # binary arithmetic
+    "add": (jnp.add, np.add, 2),
+    "subtract": (jnp.subtract, np.subtract, 2),
+    "multiply": (jnp.multiply, np.multiply, 2),
+    "divide": (jnp.divide, np.divide, 2),
+    "true_divide": (jnp.true_divide, np.true_divide, 2),
+    "floor_divide": (jnp.floor_divide, np.floor_divide, 2),
+    "mod": (jnp.mod, np.mod, 2),
+    "power": (jnp.power, np.power, 2),
+    "maximum": (jnp.maximum, np.maximum, 2),
+    "minimum": (jnp.minimum, np.minimum, 2),
+    "arctan2": (jnp.arctan2, np.arctan2, 2),
+    "hypot": (jnp.hypot, np.hypot, 2),
+    # comparisons / logic
+    "equal": (jnp.equal, np.equal, 2),
+    "not_equal": (jnp.not_equal, np.not_equal, 2),
+    "less": (jnp.less, np.less, 2),
+    "less_equal": (jnp.less_equal, np.less_equal, 2),
+    "greater": (jnp.greater, np.greater, 2),
+    "greater_equal": (jnp.greater_equal, np.greater_equal, 2),
+    "logical_and": (jnp.logical_and, np.logical_and, 2),
+    "logical_or": (jnp.logical_or, np.logical_or, 2),
+    "logical_xor": (jnp.logical_xor, np.logical_xor, 2),
+    "bitwise_and": (jnp.bitwise_and, np.bitwise_and, 2),
+    "bitwise_or": (jnp.bitwise_or, np.bitwise_or, 2),
+    "bitwise_xor": (jnp.bitwise_xor, np.bitwise_xor, 2),
+    # unary
+    "negative": (jnp.negative, np.negative, 1),
+    "absolute": (jnp.absolute, np.absolute, 1),
+    "exp": (jnp.exp, np.exp, 1),
+    "log": (jnp.log, np.log, 1),
+    "log2": (jnp.log2, np.log2, 1),
+    "log10": (jnp.log10, np.log10, 1),
+    "sqrt": (jnp.sqrt, np.sqrt, 1),
+    "square": (jnp.square, np.square, 1),
+    "sign": (jnp.sign, np.sign, 1),
+    "sin": (jnp.sin, np.sin, 1),
+    "cos": (jnp.cos, np.cos, 1),
+    "tan": (jnp.tan, np.tan, 1),
+    "arcsin": (jnp.arcsin, np.arcsin, 1),
+    "arccos": (jnp.arccos, np.arccos, 1),
+    "arctan": (jnp.arctan, np.arctan, 1),
+    "sinh": (jnp.sinh, np.sinh, 1),
+    "cosh": (jnp.cosh, np.cosh, 1),
+    "tanh": (jnp.tanh, np.tanh, 1),
+    "floor": (jnp.floor, np.floor, 1),
+    "ceil": (jnp.ceil, np.ceil, 1),
+    "rint": (jnp.rint, np.rint, 1),
+    "logical_not": (jnp.logical_not, np.logical_not, 1),
+    "invert": (jnp.invert, np.invert, 1),
+    "isnan": (jnp.isnan, np.isnan, 1),
+    "isinf": (jnp.isinf, np.isinf, 1),
+    "isfinite": (jnp.isfinite, np.isfinite, 1),
+    "reciprocal": (jnp.reciprocal, np.reciprocal, 1),
+    "conjugate": (jnp.conjugate, np.conjugate, 1),
+    # ternary
+    "where": (jnp.where, np.where, 3),
+    "clip": (jnp.clip, np.clip, 3),
+}
+
+
+class LocalExpr:
+    """Node of a fused elementwise tree. Immutable; hashable via key()."""
+
+    def emit(self, inputs: Sequence[Any]) -> Any:
+        """Trace this tree over jnp input values."""
+        raise NotImplementedError
+
+    def emit_np(self, inputs: Sequence[Any]) -> Any:
+        """Oracle evaluation with NumPy (tests / host fallback)."""
+        raise NotImplementedError
+
+    def key(self) -> Tuple:
+        """Structural cache key."""
+        raise NotImplementedError
+
+    def remap(self, mapping: Dict[int, "LocalExpr"]) -> "LocalExpr":
+        """Substitute LocalInput indices (fusion splicing)."""
+        raise NotImplementedError
+
+    def max_input(self) -> int:
+        raise NotImplementedError
+
+
+class LocalInput(LocalExpr):
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    def emit(self, inputs):
+        return inputs[self.idx]
+
+    emit_np = emit
+
+    def key(self):
+        return ("in", self.idx)
+
+    def remap(self, mapping):
+        return mapping.get(self.idx, self)
+
+    def max_input(self):
+        return self.idx
+
+    def __repr__(self):
+        return f"$i{self.idx}"
+
+
+class LocalConst(LocalExpr):
+    """A compile-time constant folded into the kernel (python scalar)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def emit(self, inputs):
+        return self.value
+
+    emit_np = emit
+
+    def key(self):
+        return ("const", type(self.value).__name__, float(self.value)
+                if isinstance(self.value, (int, float, bool)) else
+                repr(self.value))
+
+    def remap(self, mapping):
+        return self
+
+    def max_input(self):
+        return -1
+
+    def __repr__(self):
+        return f"{self.value!r}"
+
+
+class LocalUfunc(LocalExpr):
+    __slots__ = ("name", "args")
+
+    def __init__(self, name: str, args: Sequence[LocalExpr]):
+        if name not in UFUNCS:
+            raise ValueError(f"unknown ufunc {name!r}")
+        self.name = name
+        self.args = tuple(args)
+
+    def emit(self, inputs):
+        fn = UFUNCS[self.name][0]
+        return fn(*[a.emit(inputs) for a in self.args])
+
+    def emit_np(self, inputs):
+        fn = UFUNCS[self.name][1]
+        return fn(*[a.emit_np(inputs) for a in self.args])
+
+    def key(self):
+        return ("uf", self.name) + tuple(a.key() for a in self.args)
+
+    def remap(self, mapping):
+        return LocalUfunc(self.name, [a.remap(mapping) for a in self.args])
+
+    def max_input(self):
+        return max((a.max_input() for a in self.args), default=-1)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+class LocalCall(LocalExpr):
+    """A user-supplied traceable function over the inputs (the reference's
+    ``FnCallExpr``). The function must be jax-traceable; its identity is
+    part of the compile-cache key."""
+
+    __slots__ = ("fn", "args", "fn_kw")
+
+    def __init__(self, fn: Callable, args: Sequence[LocalExpr],
+                 fn_kw: Tuple[Tuple[str, Any], ...] = ()):
+        self.fn = fn
+        self.args = tuple(args)
+        self.fn_kw = tuple(fn_kw)
+
+    def emit(self, inputs):
+        return self.fn(*[a.emit(inputs) for a in self.args],
+                       **dict(self.fn_kw))
+
+    def emit_np(self, inputs):
+        return self.fn(*[a.emit_np(inputs) for a in self.args],
+                       **dict(self.fn_kw))
+
+    def key(self):
+        return (("call", self.fn, self.fn_kw)
+                + tuple(a.key() for a in self.args))
+
+    def remap(self, mapping):
+        return LocalCall(self.fn, [a.remap(mapping) for a in self.args],
+                         self.fn_kw)
+
+    def max_input(self):
+        return max((a.max_input() for a in self.args), default=-1)
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "fn")
+        return f"{name}({', '.join(map(repr, self.args))})"
+
+
+def count_ops(tree: LocalExpr) -> int:
+    """Number of op nodes (for optimizer tests asserting fusion shape)."""
+    if isinstance(tree, (LocalInput, LocalConst)):
+        return 0
+    if isinstance(tree, (LocalUfunc, LocalCall)):
+        return 1 + sum(count_ops(a) for a in tree.args)
+    raise TypeError(type(tree))

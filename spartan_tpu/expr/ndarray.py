@@ -1,0 +1,126 @@
+"""Lazy array-creation expressions.
+
+Parity with ``[U] spartan/expr/ndarray.py`` (SURVEY.md §2.3: lazy creation
+of an empty DistArray with shape/dtype/tile_hint/reducer). Creation is
+traced into the consuming jit, so a ``zeros`` feeding a map never
+materializes separately — XLA fuses the fill into the consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from .base import Expr, ValExpr
+
+
+class CreateExpr(Expr):
+    """Lazy fill: zeros/ones/full/arange/eye, traced at lowering time."""
+
+    def __init__(self, shape: Sequence[int], dtype: Any, kind: str,
+                 params: Tuple = (),
+                 tiling: Optional[Tiling] = None,
+                 tile_hint: Optional[Sequence[int]] = None):
+        shape = tuple(int(s) for s in shape)
+        super().__init__(shape, dtype)
+        self.kind = kind
+        self.params = params
+        if tiling is None and tile_hint is not None:
+            tiling = tiling_mod.from_tile_hint(shape, tile_hint)
+        self._tiling = tiling
+
+    def children(self) -> Tuple[Expr, ...]:
+        return ()
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> Expr:
+        return self
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        k = self.kind
+        if k == "zeros":
+            return jnp.zeros(self.shape, self.dtype)
+        if k == "ones":
+            return jnp.ones(self.shape, self.dtype)
+        if k == "full":
+            return jnp.full(self.shape, self.params[0], self.dtype)
+        if k == "arange":
+            return jnp.arange(*self.params, dtype=self.dtype)
+        if k == "eye":
+            n, m, k_off = self.params
+            return jnp.eye(n, m, k_off, dtype=self.dtype)
+        raise ValueError(f"unknown creation kind {self.kind!r}")
+
+    def _sig(self, ctx) -> Tuple:
+        return ("create", self.kind, self._shape, str(self._dtype),
+                self.params)
+
+    def _default_tiling(self) -> Tiling:
+        if self._tiling is not None:
+            return self._tiling
+        return tiling_mod.default_tiling(self.shape)
+
+
+class RandomExpr(Expr):
+    """Lazy random fill. The key is derived from a counter at expr build
+    time, so re-evaluating the same expr is deterministic (lineage
+    recompute stays consistent — SURVEY.md §5 failure recovery)."""
+
+    _counter = [0]
+
+    def __init__(self, shape: Sequence[int], kind: str,
+                 seed: Optional[int] = None,
+                 dtype: Any = np.float32,
+                 tiling: Optional[Tiling] = None,
+                 tile_hint: Optional[Sequence[int]] = None):
+        shape = tuple(int(s) for s in shape)
+        super().__init__(shape, dtype)
+        self.kind = kind
+        if seed is None:
+            RandomExpr._counter[0] += 1
+            seed = RandomExpr._counter[0]
+        self.seed = int(seed)
+        if tiling is None and tile_hint is not None:
+            tiling = tiling_mod.from_tile_hint(shape, tile_hint)
+        self._tiling = tiling
+
+    def children(self) -> Tuple[Expr, ...]:
+        return ()
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> Expr:
+        return self
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        key = jax.random.key(self.seed)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, self.shape, self.dtype)
+        if self.kind == "normal":
+            return jax.random.normal(key, self.shape, self.dtype)
+        if self.kind == "randint":
+            lo, hi = self.params_range
+            return jax.random.randint(key, self.shape, lo, hi, self.dtype)
+        raise ValueError(f"unknown random kind {self.kind!r}")
+
+    def _sig(self, ctx) -> Tuple:
+        return ("random", self.kind, self.seed, self._shape,
+                str(self._dtype))
+
+    def _default_tiling(self) -> Tiling:
+        if self._tiling is not None:
+            return self._tiling
+        return tiling_mod.default_tiling(self.shape)
+
+
+def ndarray(shape: Sequence[int], dtype: Any = np.float32,
+            tile_hint: Optional[Sequence[int]] = None,
+            reducer: Any = None,
+            tiling: Optional[Tiling] = None) -> CreateExpr:
+    """The reference's ``ndarray``: a new empty (zero) distributed array.
+
+    ``reducer`` is accepted for API parity; functional updates carry their
+    reducer per-write (see DistArray.update), so it is advisory here."""
+    return CreateExpr(shape, dtype, "zeros", (), tiling, tile_hint)

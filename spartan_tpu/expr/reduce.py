@@ -1,0 +1,201 @@
+"""Reduction expressions.
+
+Parity with the reference's ``[U] spartan/expr/reduce.py`` (SURVEY.md
+§2.3: per-tile local reduce + reducer-merged update into a small target).
+Per BASELINE.json:5 the reducer-merge RPC pattern becomes an XLA
+all-reduce: the whole reduction is traced into the jit and GSPMD emits
+``psum``-family collectives over ICI for the sharded axes. The general
+form (user ``local_reduce_fn``) keeps the reference's signature; for
+associative reducers applying the fn over the global (sharded) array is
+semantically identical to local-reduce + merge.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from .base import Expr, as_expr, eval_shape_of
+
+Axis = Union[None, int, Tuple[int, ...]]
+
+# name -> jnp reducer accepting (x, axis=..., keepdims=...)
+REDUCE_FNS: Dict[str, Callable] = {
+    "sum": jnp.sum,
+    "prod": jnp.prod,
+    "max": jnp.max,
+    "min": jnp.min,
+    "mean": jnp.mean,
+    "all": jnp.all,
+    "any": jnp.any,
+    "argmax": jnp.argmax,
+    "argmin": jnp.argmin,
+}
+
+_NO_KEEPDIMS = ("argmax", "argmin")
+
+
+def _norm_axis(axis: Axis, ndim: int) -> Optional[Tuple[int, ...]]:
+    if axis is None:
+        return None
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    return tuple(sorted(a % ndim for a in axis))
+
+
+class ReduceExpr(Expr):
+    """Built-in reduction over axes."""
+
+    def __init__(self, input: Expr, op: str, axis: Axis = None,
+                 keepdims: bool = False, dtype: Any = None):
+        if op not in REDUCE_FNS:
+            raise ValueError(f"unknown reduction {op!r}")
+        self.input = input
+        self.op = op
+        self.axis = _norm_axis(axis, input.ndim)
+        self.keepdims = bool(keepdims)
+        self.req_dtype = np.dtype(dtype) if dtype is not None else None
+        out = eval_shape_of(lambda x: self._emit(x), input)
+        super().__init__(out.shape, out.dtype)
+
+    def _emit(self, x: Any) -> Any:
+        fn = REDUCE_FNS[self.op]
+        ax = self.axis if self.axis is None or len(self.axis) > 1 \
+            else self.axis[0]
+        if self.op in _NO_KEEPDIMS:
+            out = fn(x, axis=ax)
+        else:
+            out = fn(x, axis=ax, keepdims=self.keepdims)
+        if self.req_dtype is not None:
+            out = out.astype(self.req_dtype)
+        return out
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.input,)
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> "ReduceExpr":
+        return ReduceExpr(new_children[0], self.op,
+                          self.axis, self.keepdims, self.req_dtype)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        return self._emit(self.input.lower(env))
+
+    def _sig(self, ctx) -> Tuple:
+        return ("reduce", self.op, self.axis, self.keepdims,
+                str(self.req_dtype), ctx.of(self.input))
+
+    def _default_tiling(self) -> Tiling:
+        t = self.input.out_tiling()
+        if self.axis is None:
+            return tiling_mod.replicated(self.ndim)
+        if self.keepdims and self.op not in _NO_KEEPDIMS:
+            for a in self.axis:
+                t = t.with_axis(a, None)
+            return t
+        for a in reversed(self.axis):
+            t = t.drop_axis(a)
+        return t
+
+
+class GeneralReduceExpr(Expr):
+    """User reduction: the reference's
+    ``ReduceExpr(input, axis, dtype_fn, local_reduce_fn, accumulate_fn)``.
+
+    ``local_reduce_fn(block, axis)`` must be jax-traceable and associative
+    with ``accumulate_fn`` as the combiner; it is applied to the sharded
+    global array and XLA inserts the cross-shard combine collectives."""
+
+    def __init__(self, input: Expr, axis: Axis,
+                 local_reduce_fn: Callable,
+                 accumulate_fn: Optional[Callable] = None,
+                 dtype: Any = None, keepdims: bool = False):
+        self.input = input
+        self.axis = _norm_axis(axis, input.ndim)
+        self.local_reduce_fn = local_reduce_fn
+        self.accumulate_fn = accumulate_fn
+        self.keepdims = bool(keepdims)
+        ax = self.axis if self.axis is None or len(self.axis) > 1 \
+            else self.axis[0]
+        out = eval_shape_of(
+            lambda x: local_reduce_fn(x, axis=ax), input)
+        if dtype is not None:
+            out = type(out)(out.shape, np.dtype(dtype))
+        super().__init__(out.shape, out.dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.input,)
+
+    def replace_children(self, new_children: Tuple[Expr, ...]
+                         ) -> "GeneralReduceExpr":
+        return GeneralReduceExpr(new_children[0], self.axis,
+                                 self.local_reduce_fn, self.accumulate_fn,
+                                 self.dtype, self.keepdims)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        x = self.input.lower(env)
+        ax = self.axis if self.axis is None or len(self.axis) > 1 \
+            else self.axis[0]
+        out = self.local_reduce_fn(x, axis=ax)
+        return out.astype(self.dtype) if out.dtype != self.dtype else out
+
+    def _sig(self, ctx) -> Tuple:
+        return ("greduce", self.local_reduce_fn, self.accumulate_fn,
+                self.axis, str(self.dtype), ctx.of(self.input))
+
+    def _default_tiling(self) -> Tiling:
+        t = self.input.out_tiling()
+        if self.axis is None:
+            return tiling_mod.replicated(self.ndim)
+        for a in reversed(self.axis):
+            t = t.drop_axis(a)
+        return t
+
+
+def reduce(input: Any, axis: Axis = None, *,
+           local_reduce_fn: Callable,
+           accumulate_fn: Optional[Callable] = None,
+           dtype: Any = None) -> GeneralReduceExpr:
+    return GeneralReduceExpr(as_expr(input), axis, local_reduce_fn,
+                             accumulate_fn, dtype)
+
+
+def _make(op: str):
+    def builder(input: Any, axis: Axis = None, keepdims: bool = False,
+                dtype: Any = None) -> ReduceExpr:
+        return ReduceExpr(as_expr(input), op, axis, keepdims, dtype)
+
+    builder.__name__ = op
+    return builder
+
+
+sum = _make("sum")
+prod = _make("prod")
+mean = _make("mean")
+
+
+def max(input: Any, axis: Axis = None, keepdims: bool = False) -> ReduceExpr:
+    return ReduceExpr(as_expr(input), "max", axis, keepdims)
+
+
+def min(input: Any, axis: Axis = None, keepdims: bool = False) -> ReduceExpr:
+    return ReduceExpr(as_expr(input), "min", axis, keepdims)
+
+
+def all(input: Any, axis: Axis = None, keepdims: bool = False) -> ReduceExpr:
+    return ReduceExpr(as_expr(input), "all", axis, keepdims)
+
+
+def any(input: Any, axis: Axis = None, keepdims: bool = False) -> ReduceExpr:
+    return ReduceExpr(as_expr(input), "any", axis, keepdims)
+
+
+def argmax(input: Any, axis: Axis = None) -> ReduceExpr:
+    return ReduceExpr(as_expr(input), "argmax", axis)
+
+
+def argmin(input: Any, axis: Axis = None) -> ReduceExpr:
+    return ReduceExpr(as_expr(input), "argmin", axis)

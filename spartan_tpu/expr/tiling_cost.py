@@ -1,0 +1,15 @@
+"""ICI-cost model for the smart-tiling pass.
+
+Skeleton for SURVEY.md §7 step 6; currently assigns nothing (each node's
+``_default_tiling`` propagation stands). The full candidate/cost search
+lands with the dot and shuffle layers, where resharding cost actually
+bites.
+"""
+
+from __future__ import annotations
+
+from .base import Expr
+
+
+def assign_tilings(root: Expr) -> Expr:
+    return root

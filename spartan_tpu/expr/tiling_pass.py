@@ -1,0 +1,33 @@
+"""Smart-tiling pass: choose output shardings via an ICI-cost model.
+
+The reference's headline optimization (SURVEY.md §2.3 pass (d), the
+ATC'15 "smart tiling"): build candidate tilings per array, edge costs =
+bytes moved per op given producer/consumer tilings, pick the min-cost
+assignment. Re-targeted for TPU (SURVEY.md §7 step 6): candidates are
+mesh shardings (row/col/block/replicated), the cost of an edge is the
+bytes a resharding collective must move over ICI, and the output is a
+``_forced_tiling`` on each node which ``evaluate`` turns into GSPMD
+out-shardings.
+
+The full cost model lands with the dot/shuffle layer; this module wires
+the pass into the pipeline so the FLAG ablation surface exists from the
+start.
+"""
+
+from __future__ import annotations
+
+from .base import Expr
+from .optimize import Pass, register_pass
+
+
+class SmartTilingPass(Pass):
+    name = "auto_tiling"
+    flag = "opt_auto_tiling"
+
+    def run(self, root: Expr) -> Expr:
+        from . import tiling_cost
+
+        return tiling_cost.assign_tilings(root)
+
+
+register_pass(SmartTilingPass())

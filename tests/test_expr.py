@@ -1,0 +1,207 @@
+"""Expr-level integration tests — the reference's oracle pattern
+(SURVEY.md §4): build small multi-tile arrays, run lazy exprs, glom(),
+assert against plain NumPy."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.expr import base as expr_base
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def _np_pair(shape=(8, 8), seed=0, tiling=None):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(*shape).astype(np.float32)
+    return x, st.from_numpy(x, tiling=tiling)
+
+
+def test_elementwise_chain_vs_numpy():
+    x, ex = _np_pair(seed=1)
+    y, ey = _np_pair(seed=2)
+    out = ((ex + ey) * 3.0 - ex / (ey + 1.0)).glom()
+    expect = (x + y) * 3.0 - x / (y + 1.0)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_scalar_and_reverse_ops():
+    x, ex = _np_pair(seed=3)
+    np.testing.assert_allclose((2.0 - ex).glom(), 2.0 - x, rtol=1e-6)
+    np.testing.assert_allclose((1.0 / (ex + 1)).glom(), 1.0 / (x + 1),
+                               rtol=1e-6)
+    np.testing.assert_allclose((ex ** 2).glom(), x ** 2, rtol=1e-6)
+    np.testing.assert_allclose((-ex).glom(), -x, rtol=1e-6)
+    np.testing.assert_allclose(builtins_abs(ex).glom(), np.abs(x), rtol=1e-6)
+
+
+def builtins_abs(e):
+    return abs(e)
+
+
+def test_comparisons_and_where():
+    x, ex = _np_pair(seed=4)
+    y, ey = _np_pair(seed=5)
+    np.testing.assert_array_equal((ex > ey).glom(), x > y)
+    np.testing.assert_array_equal((ex <= ey).glom(), x <= y)
+    out = st.where(ex > ey, ex, ey).glom()
+    np.testing.assert_allclose(out, np.where(x > y, x, y))
+
+
+def test_broadcasting():
+    x, ex = _np_pair((8, 8), seed=6)
+    v = np.arange(8, dtype=np.float32)
+    ev = st.from_numpy(v)
+    np.testing.assert_allclose((ex + ev).glom(), x + v, rtol=1e-6)
+    col = v.reshape(8, 1)
+    ecol = st.from_numpy(col)
+    np.testing.assert_allclose((ex * ecol).glom(), x * col, rtol=1e-6)
+
+
+def test_global_sum_config1():
+    """Config 1 (BASELINE.json:7): elementwise map + global sum."""
+    x, ex = _np_pair((16, 16), seed=7, tiling=None)
+    total = ((ex + ex) * 0.5).sum().glom()
+    np.testing.assert_allclose(total, x.sum(), rtol=1e-5)
+    assert total.shape == ()
+
+
+def test_axis_reductions():
+    x, ex = _np_pair((8, 6), seed=8)
+    np.testing.assert_allclose(ex.sum(axis=0).glom(), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(ex.sum(axis=1).glom(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(ex.mean(axis=0).glom(), x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(ex.max().glom(), x.max())
+    np.testing.assert_allclose(ex.min(axis=1).glom(), x.min(1))
+    np.testing.assert_allclose(
+        ex.sum(axis=1, keepdims=True).glom(), x.sum(1, keepdims=True),
+        rtol=1e-5)
+
+
+def test_argminmax():
+    x, ex = _np_pair((8, 6), seed=9)
+    np.testing.assert_array_equal(ex.argmax().glom(), x.argmax())
+    np.testing.assert_array_equal(ex.argmin(axis=1).glom(), x.argmin(1))
+    np.testing.assert_array_equal(ex.argmax(axis=0).glom(), x.argmax(0))
+
+
+def test_general_reduce():
+    x, ex = _np_pair((8, 6), seed=10)
+    import jax.numpy as jnp
+
+    out = st.reduce(ex, axis=0, local_reduce_fn=jnp.sum,
+                    accumulate_fn=jnp.add).glom()
+    np.testing.assert_allclose(out, x.sum(0), rtol=1e-5)
+
+
+def test_creation_exprs():
+    np.testing.assert_array_equal(st.zeros((4, 4)).glom(),
+                                  np.zeros((4, 4), np.float32))
+    np.testing.assert_array_equal(st.ones((4, 4)).glom(),
+                                  np.ones((4, 4), np.float32))
+    np.testing.assert_array_equal(st.full((3, 3), 2.5).glom(),
+                                  np.full((3, 3), 2.5, np.float32))
+    np.testing.assert_array_equal(st.arange(10).glom(),
+                                  np.arange(10, dtype=np.int32))
+    np.testing.assert_array_equal(st.eye(4).glom(), np.eye(4, dtype=np.float32))
+    r = st.rand(8, 8, seed=42).glom()
+    assert ((r >= 0) & (r < 1)).all()
+    # deterministic by seed
+    np.testing.assert_array_equal(r, st.rand(8, 8, seed=42).glom())
+
+
+def test_lazy_no_eval_until_force():
+    ex = st.rand(8, 8, seed=1)
+    e2 = ex + 1.0
+    assert e2._result is None
+    _ = e2.glom()
+    assert e2._result is not None
+
+
+def test_memo_cache_reuses_result():
+    ex = st.rand(8, 8, seed=2)
+    e2 = (ex * 2.0).sum()
+    a = e2.glom()
+    # second glom: cached, same object
+    res = e2._result
+    b = e2.glom()
+    assert e2._result is res
+    np.testing.assert_array_equal(a, b)
+
+
+def test_compile_cache_hits_across_iterations():
+    """Same DAG structure with different leaf values / scalars must reuse
+    the compiled executable (the k-means/SGD loop pattern)."""
+    st.clear_compile_cache()
+    x = np.ones((8, 8), np.float32)
+    for i in range(4):
+        ex = st.from_numpy(x * (i + 1))
+        out = ((ex * float(i + 1)) + 1.0).sum().glom()
+        np.testing.assert_allclose(out, (x * (i + 1) * (i + 1) + 1).sum(),
+                                   rtol=1e-5)
+    assert st.compile_cache_size() == 1
+
+
+def test_astype_and_misc():
+    x, ex = _np_pair(seed=11)
+    assert st.astype(ex, np.int32).glom().dtype == np.int32
+    np.testing.assert_allclose(st.norm(ex).glom(),
+                               np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(st.exp(ex).glom(), np.exp(x), rtol=1e-6)
+    np.testing.assert_allclose(st.sqrt(ex).glom(), np.sqrt(x), rtol=1e-6)
+    np.testing.assert_array_equal(st.count_nonzero(ex > 0.5).glom(),
+                                  np.count_nonzero(x > 0.5))
+
+
+def test_diag_tril_scan():
+    x, ex = _np_pair(seed=12)
+    np.testing.assert_allclose(st.diagonal(ex).glom(), np.diagonal(x))
+    np.testing.assert_allclose(st.tril(ex).glom(), np.tril(x))
+    np.testing.assert_allclose(st.triu(ex, 1).glom(), np.triu(x, 1))
+    v = np.arange(5, dtype=np.float32)
+    np.testing.assert_allclose(st.diag(st.from_numpy(v)).glom(), np.diag(v))
+    np.testing.assert_allclose(st.scan(ex, axis=0).glom(),
+                               np.cumsum(x, axis=0), rtol=1e-5)
+
+
+def test_bincount():
+    v = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int32)
+    np.testing.assert_array_equal(st.bincount(st.from_numpy(v)).glom(),
+                                  np.bincount(v))
+
+
+def test_user_map():
+    import jax.numpy as jnp
+
+    x, ex = _np_pair(seed=13)
+    out = st.map(lambda a: jnp.sin(a) + 1.0, ex).glom()
+    np.testing.assert_allclose(out, np.sin(x) + 1.0, rtol=1e-6)
+
+
+def test_map_with_location():
+    import jax.numpy as jnp
+
+    x = np.zeros((8, 8), np.float32)
+    ex = st.from_numpy(x, tiling=st.Tiling(("x", "y")))
+
+    def kern(block, ul):
+        # fill each element with its global row index
+        rows = ul[0] + jnp.arange(block.shape[0])[:, None]
+        return jnp.broadcast_to(rows.astype(block.dtype), block.shape)
+
+    out = st.map_with_location(ex, kern).glom()
+    expect = np.broadcast_to(
+        np.arange(8, dtype=np.float32)[:, None], (8, 8))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_scalar_expr_no_recompile():
+    st.clear_compile_cache()
+    x = np.ones((4, 4), np.float32)
+    for lr in (0.1, 0.2, 0.3):
+        ex = st.from_numpy(x)
+        (ex * lr).glom()
+    assert st.compile_cache_size() == 1
