@@ -184,6 +184,18 @@ def default_tiling(shape: Sequence[int],
     return Tiling(axes)
 
 
+def sanitize(t: Tiling, shape: Sequence[int],
+             mesh: Optional[Mesh] = None) -> Tiling:
+    """Drop mesh axes from dims they don't divide evenly (jit
+    out-shardings demand divisibility; GSPMD would otherwise pad)."""
+    mesh = mesh or mesh_mod.get_mesh()
+    axes = list(t.axes)
+    for i, (d, n) in enumerate(zip(shape, t.tiles_per_dim(mesh))):
+        if n > 1 and (int(d) % n != 0 or int(d) < n):
+            axes[i] = None
+    return Tiling(axes)
+
+
 def spec_to_tiling(spec: P, ndim: int) -> Tiling:
     axes = list(spec) + [None] * (ndim - len(spec))
     return Tiling(axes[:ndim])

@@ -4,12 +4,27 @@ from .base import (Expr, ScalarExpr, ValExpr, as_expr, clear_compile_cache,
                    compile_cache_size, evaluate, lazify)
 from .builtins import *  # noqa: F401,F403
 from .builtins import __all__ as _builtin_all
+from .assign import WriteExpr, assign, write_array
+from .dot import DotExpr, dot, dot_shardmap
+from .filter import GatherExpr, filter
 from .map import MapExpr, map, map_with_location
+from .map2 import Map2Expr, ShardMap2Expr, map2, shard_map2
 from .ndarray import CreateExpr, RandomExpr
 from .optimize import dag_nodes, optimize
+from .outer import OuterExpr, outer
 from .reduce import GeneralReduceExpr, ReduceExpr
+from .reshape import (ConcatExpr, ReshapeExpr, TransposeExpr, concatenate,
+                      ravel, reshape, transpose)
+from .shuffle import shuffle
+from .slice import SliceExpr, make_slice
 
 __all__ = ["Expr", "ValExpr", "ScalarExpr", "as_expr", "lazify", "evaluate",
            "optimize", "dag_nodes", "map", "map_with_location", "MapExpr",
            "ReduceExpr", "GeneralReduceExpr", "CreateExpr", "RandomExpr",
-           "compile_cache_size", "clear_compile_cache"] + list(_builtin_all)
+           "compile_cache_size", "clear_compile_cache",
+           "assign", "write_array", "WriteExpr", "dot", "dot_shardmap",
+           "DotExpr", "filter", "GatherExpr", "map2", "shard_map2",
+           "Map2Expr", "ShardMap2Expr", "outer", "OuterExpr", "shuffle",
+           "transpose", "reshape", "ravel", "concatenate", "SliceExpr",
+           "TransposeExpr", "ReshapeExpr", "ConcatExpr",
+           ] + list(_builtin_all)

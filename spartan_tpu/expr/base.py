@@ -268,6 +268,11 @@ class Expr:
 
         return dot(self, other)
 
+    def __matmul__(self, other) -> "Expr":
+        from .dot import dot
+
+        return dot(self, other)
+
     def transpose(self, *axes) -> "Expr":
         from .reshape import transpose
 
@@ -438,8 +443,8 @@ def evaluate(expr: Expr) -> DistArray:
     ctx = _SigCtx()
     root_sig = ctx.of(dag)
     leaves = ctx.leaves
-    out_tiling = dag.out_tiling()
     mesh = mesh_mod.get_mesh()
+    out_tiling = tiling_mod.sanitize(dag.out_tiling(), dag.shape, mesh)
     key = (root_sig, out_tiling.axes,
            tuple(sorted(mesh.shape.items())))
 
@@ -447,12 +452,16 @@ def evaluate(expr: Expr) -> DistArray:
         jitted = _compile_cache.get(key)
     if jitted is None:
         leaf_ids = tuple(l._id for l in leaves)
+        out_sharding = out_tiling.sharding(mesh)
 
         def traced(*args: Any) -> Any:
             env: Dict[int, Any] = dict(zip(leaf_ids, args))
-            return dag.lower(env)
+            out = dag.lower(env)
+            # a constraint (not jit out_shardings) so GSPMD propagation can
+            # negotiate ops like reverse that hard-fail on output overrides
+            return jax.lax.with_sharding_constraint(out, out_sharding)
 
-        jitted = jax.jit(traced, out_shardings=out_tiling.sharding(mesh))
+        jitted = jax.jit(traced)
         with _cache_lock:
             _compile_cache[key] = jitted
         log_debug("compiled expr dag sig=%s", hash(key))

@@ -1,0 +1,75 @@
+"""Boolean-mask and integer-array (fancy) indexing.
+
+Parity with ``[U] spartan/expr/filter.py`` (SURVEY.md §2.3 "boolean/fancy
+FilterExpr"). Two regimes, per SURVEY.md §7 hard part 2 (dynamic shapes
+are hostile to XLA):
+
+* **Integer-array gather** — static output shape, fully traced (one XLA
+  gather over the sharded operand).
+* **Boolean mask** — output size is data-dependent. The mask is forced
+  eagerly (it is tiny relative to the data), its nonzero indices computed
+  on host, and the gather then traced with a static index set. This
+  mirrors the reference's semantics exactly (it too materialized the
+  compacted result eagerly through tile RPCs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from .base import Expr, ValExpr, as_expr
+
+
+class GatherExpr(Expr):
+    """x[indices] (or x[i_idx, j_idx, ...]) with static index arrays."""
+
+    def __init__(self, input: Expr, indices: Tuple[np.ndarray, ...]):
+        self.input = input
+        self.indices = indices
+        out = np.broadcast_shapes(*[ix.shape for ix in indices])
+        shape = out + input.shape[len(indices):]
+        super().__init__(shape, input.dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.input,)
+
+    def replace_children(self, new_children) -> "GatherExpr":
+        return GatherExpr(new_children[0], self.indices)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        x = self.input.lower(env)
+        return x[tuple(jnp.asarray(ix) for ix in self.indices)]
+
+    def _sig(self, ctx) -> Tuple:
+        key = tuple((ix.shape, ix.tobytes()) for ix in self.indices)
+        return ("gather", key, ctx.of(self.input))
+
+    def _default_tiling(self) -> Tiling:
+        return tiling_mod.default_tiling(self.shape)
+
+
+def filter(x: Any, mask_or_indices: Any) -> Expr:
+    x = as_expr(x)
+    idx = mask_or_indices
+    if isinstance(idx, Expr):
+        if idx.dtype == np.bool_:
+            mask = idx.glom()
+            nz = np.nonzero(mask)
+            return GatherExpr(x, tuple(np.asarray(i) for i in nz))
+        idx = idx.glom()
+    idx = np.asarray(idx)
+    if idx.dtype == np.bool_:
+        nz = np.nonzero(idx)
+        return GatherExpr(x, tuple(np.asarray(i) for i in nz))
+    if not np.issubdtype(idx.dtype, np.integer):
+        raise TypeError(f"unsupported index dtype {idx.dtype}")
+    dim = x.shape[0]
+    idx = np.where(idx < 0, idx + dim, idx)
+    if (idx < 0).any() or (idx >= dim).any():
+        raise IndexError("fancy index out of bounds")
+    return GatherExpr(x, (idx,))

@@ -1,0 +1,49 @@
+"""Block outer-product (``[U] spartan/expr/outer.py`` — SURVEY.md §2.3:
+the tile-pair pattern used with dot). The traced lowering is one einsum;
+GSPMD materializes C[i,j] blocks on the (x, y) mesh positions — the tile
+pairs of the reference become mesh coordinates."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from .base import Expr, as_expr
+
+
+class OuterExpr(Expr):
+    def __init__(self, a: Expr, b: Expr,
+                 fn: Optional[Callable] = None):
+        if a.ndim != 1 or b.ndim != 1:
+            raise ValueError("outer requires 1-D operands")
+        self.a = a
+        self.b = b
+        self.fn = fn
+        super().__init__((a.size, b.size), np.result_type(a.dtype, b.dtype))
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.a, self.b)
+
+    def replace_children(self, new_children) -> "OuterExpr":
+        return OuterExpr(new_children[0], new_children[1], self.fn)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        av = self.a.lower(env)
+        bv = self.b.lower(env)
+        if self.fn is not None:
+            return self.fn(av[:, None], bv[None, :])
+        return jnp.outer(av, bv)
+
+    def _sig(self, ctx) -> Tuple:
+        return ("outer", self.fn, ctx.of(self.a), ctx.of(self.b))
+
+    def _default_tiling(self) -> Tiling:
+        return tiling_mod.block(2)
+
+
+def outer(a: Any, b: Any, fn: Optional[Callable] = None) -> OuterExpr:
+    return OuterExpr(as_expr(a), as_expr(b), fn)

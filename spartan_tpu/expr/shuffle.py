@@ -1,0 +1,93 @@
+"""shuffle: arbitrary tile redistribution with a user kernel.
+
+Parity with ``[U] spartan/expr/shuffle.py`` (SURVEY.md §2.3: per-source-
+tile kernel emits ``(target_extent, data)`` updates into a (possibly new)
+target array with a combiner — Spartan's all-to-all). Lowering strategy
+per SURVEY.md §7 hard part 1 (dual paths):
+
+* Structured redistributions (transpose / reshape / retile / slice-write)
+  never come here — they are traced exprs whose sharding change makes
+  GSPMD emit the all-to-all (see reshape.py, DistArray.retile).
+* The *general* shuffle — an arbitrary Python kernel emitting variable
+  extents — is not traceable. It runs as a host-side scatter-combine over
+  the source tiles (exactly the reference's semantics, which also ran
+  Python per tile), then re-enters the device world as a new DistArray.
+  The combiner is applied in deterministic source-tile order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..array import distarray as da
+from ..array import tiling as tiling_mod
+from ..array.extent import TileExtent
+from ..array.tiling import Tiling
+from .base import Expr, ValExpr, as_expr, evaluate
+
+_COMBINERS = {
+    None: lambda tgt, sl, v: tgt.__setitem__(sl, v),
+    "set": lambda tgt, sl, v: tgt.__setitem__(sl, v),
+    "add": lambda tgt, sl, v: tgt.__setitem__(sl, tgt[sl] + v),
+    "mul": lambda tgt, sl, v: tgt.__setitem__(sl, tgt[sl] * v),
+    "max": lambda tgt, sl, v: tgt.__setitem__(sl, np.maximum(tgt[sl], v)),
+    "min": lambda tgt, sl, v: tgt.__setitem__(sl, np.minimum(tgt[sl], v)),
+}
+
+
+def shuffle(source: Any,
+            kernel: Callable[[TileExtent, np.ndarray],
+                             Iterable[Tuple[TileExtent, np.ndarray]]],
+            target_shape: Optional[Sequence[int]] = None,
+            target: Optional[Any] = None,
+            dtype: Any = None,
+            combiner: Any = "add",
+            tile_hint: Optional[Sequence[int]] = None,
+            kw: Optional[dict] = None) -> Expr:
+    """Run ``kernel(extent, block, **kw)`` over every source tile; scatter
+    its emitted ``(target_extent, data)`` pairs into the target with
+    ``combiner``. Returns a ValExpr over the new DistArray (evaluated
+    eagerly — the kernel is arbitrary Python)."""
+    source = as_expr(source)
+    src = evaluate(source)
+    src_np = src.glom()
+
+    if isinstance(combiner, np.ufunc) or callable(combiner):
+        name = {np.add: "add", np.multiply: "mul", np.maximum: "max",
+                np.minimum: "min"}.get(combiner)
+        if name is None and combiner is not None:
+            raise ValueError(f"unsupported combiner {combiner!r}")
+        combiner = name
+    if combiner not in _COMBINERS:
+        raise ValueError(f"unsupported combiner {combiner!r}")
+    apply_update = _COMBINERS[combiner]
+
+    if target is not None:
+        target = as_expr(target)
+        tgt_np = evaluate(target).glom().copy()
+        out_shape = tgt_np.shape
+        out_dtype = tgt_np.dtype
+        out_tiling = evaluate(target).tiling
+    else:
+        if target_shape is None:
+            raise ValueError("shuffle needs target_shape or target")
+        out_shape = tuple(int(s) for s in target_shape)
+        out_dtype = np.dtype(dtype) if dtype is not None else src.dtype
+        tgt_np = np.zeros(out_shape, out_dtype)
+        out_tiling = None
+
+    kw = kw or {}
+    for ext in src.extents():
+        block = src_np[ext.to_slice()]
+        for t_ext, data in kernel(ext, block, **kw):
+            if not isinstance(t_ext, TileExtent):
+                t_ext = TileExtent(t_ext[0], t_ext[1], out_shape)
+            data = np.asarray(data, dtype=out_dtype)
+            if data.shape != t_ext.shape:
+                data = np.broadcast_to(data, t_ext.shape)
+            apply_update(tgt_np, t_ext.to_slice(), data)
+
+    result = da.from_numpy(tgt_np, tiling=out_tiling, tile_hint=tile_hint)
+    return ValExpr(result)

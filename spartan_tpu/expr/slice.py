@@ -1,0 +1,138 @@
+"""Lazy slicing (``[U] spartan/expr/slice.py`` — SURVEY.md §2.3).
+
+A ``SliceExpr`` is metadata until forced; XLA lowers the slice of a
+sharded operand to per-shard slices plus the minimal collective when the
+region crosses shard boundaries (the reference issued one RPC per
+overlapped tile — SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from .base import Expr, as_expr
+
+Index = Union[int, slice, type(Ellipsis), None]
+
+
+def _normalize_index(idx: Any, shape: Tuple[int, ...]
+                     ) -> Tuple[Tuple[Index, ...], Tuple[int, ...],
+                                Tuple[int, ...]]:
+    """Normalize to a full per-axis index tuple; return (index, out_shape,
+    squeezed_axes)."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    # expand Ellipsis
+    n_explicit = sum(1 for i in idx if i is not Ellipsis and i is not None)
+    out: List[Index] = []
+    for i in idx:
+        if i is Ellipsis:
+            out.extend([slice(None)] * (len(shape) - n_explicit))
+        else:
+            out.append(i)
+    while len([i for i in out if i is not None]) < len(shape):
+        out.append(slice(None))
+    if len([i for i in out if i is not None]) > len(shape):
+        raise IndexError(f"too many indices for shape {shape}")
+
+    norm: List[Index] = []
+    out_shape: List[int] = []
+    squeezed: List[int] = []
+    axis = 0
+    res_axis = 0
+    for i in out:
+        if i is None:  # np.newaxis
+            out_shape.append(1)
+            norm.append(None)
+            res_axis += 1
+            continue
+        dim = shape[axis]
+        if isinstance(i, (int, np.integer)):
+            ii = int(i)
+            if ii < 0:
+                ii += dim
+            if not 0 <= ii < dim:
+                raise IndexError(
+                    f"index {i} out of bounds for axis {axis} (size {dim})")
+            norm.append(ii)
+            squeezed.append(axis)
+        elif isinstance(i, slice):
+            start, stop, step = i.indices(dim)
+            n = len(range(start, stop, step))
+            # a negative stop from .indices() means "past the beginning";
+            # storing it verbatim would re-wrap to dim-1 — use None
+            stored_stop: Optional[int] = stop
+            if step < 0 and stop < 0:
+                stored_stop = None
+            norm.append(slice(start, stored_stop, step))
+            out_shape.append(n)
+            res_axis += 1
+        else:
+            raise TypeError(f"unsupported index component {i!r}")
+        axis += 1
+    return tuple(norm), tuple(out_shape), tuple(squeezed)
+
+
+class SliceExpr(Expr):
+    """Basic (rectangular, possibly strided) indexing with int-squeeze and
+    np.newaxis support."""
+
+    def __init__(self, input: Expr, index: Tuple[Index, ...],
+                 out_shape: Tuple[int, ...], squeezed: Tuple[int, ...]):
+        self.input = input
+        self.index = index
+        self.squeezed = squeezed
+        super().__init__(out_shape, input.dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.input,)
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> "SliceExpr":
+        return SliceExpr(new_children[0], self.index, self._shape,
+                         self.squeezed)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        x = self.input.lower(env)
+        idx = tuple(i if i is not None else np.newaxis for i in self.index)
+        return x[idx]
+
+    def _sig(self, ctx) -> Tuple:
+        key = tuple((i.start, i.stop, i.step) if isinstance(i, slice)
+                    else i for i in self.index)
+        return ("slice", key, ctx.of(self.input))
+
+    def _default_tiling(self) -> Tiling:
+        """Keep the input's sharding on axes taken whole; drop it on
+        cut/strided/squeezed axes (their shards no longer align)."""
+        in_t = self.input.out_tiling()
+        in_shape = self.input.shape
+        axes: List[Optional[str]] = []
+        src_axis = 0
+        for i in self.index:
+            if i is None:
+                axes.append(None)
+                continue
+            if isinstance(i, int):
+                src_axis += 1
+                continue
+            full = (i.start == 0 and i.step == 1
+                    and i.stop == in_shape[src_axis])
+            axes.append(in_t.axes[src_axis] if full else None)
+            src_axis += 1
+        return Tiling(axes)
+
+
+def make_slice(input: Expr, idx: Any) -> Expr:
+    """Entry point for ``Expr.__getitem__``: basic indexing here; boolean /
+    integer-array indexing delegates to filter (SURVEY.md §2.3)."""
+    input = as_expr(input)
+    if isinstance(idx, Expr) or isinstance(idx, np.ndarray):
+        from .filter import filter as _filter
+
+        return _filter(input, idx)
+    index, out_shape, squeezed = _normalize_index(idx, input.shape)
+    return SliceExpr(input, index, out_shape, squeezed)

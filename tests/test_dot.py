@@ -1,0 +1,138 @@
+"""dot / map2 / outer / shuffle tests (SURVEY.md §4 test_dot family;
+config 2 of BASELINE.json:8 in miniature)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def _pair(shape, seed=0):
+    x = np.random.RandomState(seed).rand(*shape).astype(np.float32)
+    return x, st.from_numpy(x)
+
+
+def test_dot_2d():
+    a, ea = _pair((16, 8), 1)
+    b, eb = _pair((8, 12), 2)
+    np.testing.assert_allclose(st.dot(ea, eb).glom(), a @ b, rtol=1e-4)
+    np.testing.assert_allclose((ea @ eb).glom(), a @ b, rtol=1e-4)
+    np.testing.assert_allclose(ea.dot(eb).glom(), a @ b, rtol=1e-4)
+
+
+def test_dot_1d_cases():
+    a, ea = _pair((8,), 3)
+    b, eb = _pair((8,), 4)
+    np.testing.assert_allclose(st.dot(ea, eb).glom(), a @ b, rtol=1e-4)
+    m, em = _pair((8, 6), 5)
+    np.testing.assert_allclose(st.dot(ea, em).glom(), a @ m, rtol=1e-4)
+    np.testing.assert_allclose(st.dot(em.T, ea).glom(), m.T @ a, rtol=1e-4)
+
+
+def test_dot_mismatch():
+    _, ea = _pair((4, 4))
+    _, eb = _pair((5, 4))
+    with pytest.raises(ValueError):
+        st.dot(ea, eb)
+
+
+def test_dot_sharded_operands():
+    """Sharded x sharded: result correct whatever the input tilings."""
+    a, _ = _pair((16, 16), 6)
+    b, _ = _pair((16, 16), 7)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    eb = st.from_numpy(b, tiling=tiling.col(2))
+    out = st.dot(ea, eb)
+    np.testing.assert_allclose(out.glom(), a @ b, rtol=1e-4)
+    # the result is block-tiled over the mesh
+    assert out.evaluate().tiling == tiling.block(2)
+
+
+def test_dot_shardmap_variant():
+    a, _ = _pair((16, 8), 8)
+    b, _ = _pair((8, 12), 9)
+    ea, eb = st.from_numpy(a), st.from_numpy(b)
+    np.testing.assert_allclose(st.dot_shardmap(ea, eb).glom(), a @ b,
+                               rtol=1e-4)
+
+
+def test_dot_in_larger_expr():
+    a, ea = _pair((8, 8), 10)
+    b, eb = _pair((8, 8), 11)
+    expr = (st.dot(ea, eb) + 1.0).sum()
+    np.testing.assert_allclose(expr.glom(), (a @ b + 1).sum(), rtol=1e-4)
+
+
+def test_outer():
+    a, ea = _pair((8,), 12)
+    b, eb = _pair((6,), 13)
+    np.testing.assert_allclose(st.outer(ea, eb).glom(), np.outer(a, b),
+                               rtol=1e-5)
+    # custom combine fn
+    out = st.outer(ea, eb, fn=lambda x, y: x + y).glom()
+    np.testing.assert_allclose(out, a[:, None] + b[None, :], rtol=1e-5)
+
+
+def test_map2_traced():
+    import jax.numpy as jnp
+
+    p, ep = _pair((16, 4), 14)
+    c, ec = _pair((3, 4), 15)
+
+    def sq_dists(points, centers):
+        return ((points[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+
+    out = st.map2([ep, ec], sq_dists).glom()
+    expect = ((p[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_shard_map2():
+    """Per-block kernel: blockwise scale with owner-computes."""
+    x, ex = _pair((8, 8), 16)
+    t = tiling.row(2)
+
+    def kernel(block):
+        return block * 2.0
+
+    out = st.shard_map2([ex], kernel, in_tilings=[t], out_tiling=t,
+                        out_shape=(8, 8), out_dtype=np.float32)
+    np.testing.assert_allclose(out.glom(), x * 2, rtol=1e-6)
+
+
+def test_shuffle_general():
+    """Arbitrary redistribution: reverse tiles along axis 0 via a Python
+    kernel emitting target extents (the reference's shuffle semantics)."""
+    from spartan_tpu.array.extent import TileExtent
+
+    x, _ = _pair((8, 4), 17)
+    ex = st.from_numpy(x, tiling=tiling.row(2))
+    n = x.shape[0]
+
+    def rev_kernel(ext, block):
+        ul = (n - ext.lr[0],) + ext.ul[1:]
+        lr = (n - ext.ul[0],) + ext.lr[1:]
+        yield TileExtent(ul, lr, x.shape), block[::-1]
+
+    out = st.shuffle(ex, rev_kernel, target_shape=x.shape, combiner="set")
+    np.testing.assert_array_equal(out.glom(), x[::-1])
+
+
+def test_shuffle_combiner_add():
+    """Overlapping emits combine with the reducer (histogram-style)."""
+    from spartan_tpu.array.extent import TileExtent
+
+    x = np.ones((8, 2), np.float32)
+    ex = st.from_numpy(x, tiling=tiling.row(2))
+
+    def to_origin(ext, block):
+        yield TileExtent((0, 0), (1, 2), (1, 2)), block.sum(0, keepdims=True)
+
+    out = st.shuffle(ex, to_origin, target_shape=(1, 2), combiner="add")
+    np.testing.assert_array_equal(out.glom(), np.full((1, 2), 8.0))

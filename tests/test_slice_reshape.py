@@ -1,0 +1,121 @@
+"""Slice / transpose / reshape / concat / assign / filter tests —
+NumPy-oracle pattern (SURVEY.md §4: test_slice, test_reshape,
+test_transpose, test_filter, test_assign families)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def _pair(shape=(8, 8), seed=0):
+    x = np.random.RandomState(seed).rand(*shape).astype(np.float32)
+    return x, st.from_numpy(x)
+
+
+def test_basic_slicing():
+    x, ex = _pair((10, 12))
+    np.testing.assert_array_equal(ex[2:5, 3:7].glom(), x[2:5, 3:7])
+    np.testing.assert_array_equal(ex[:, 4].glom(), x[:, 4])
+    np.testing.assert_array_equal(ex[3].glom(), x[3])
+    np.testing.assert_array_equal(ex[-1].glom(), x[-1])
+    np.testing.assert_array_equal(ex[1:9:2].glom(), x[1:9:2])
+    np.testing.assert_array_equal(ex[::-1].glom(), x[::-1])
+    np.testing.assert_array_equal(ex[..., 0].glom(), x[..., 0])
+    np.testing.assert_array_equal(ex[None, 2].glom(), x[None, 2])
+
+
+def test_slice_of_expr():
+    x, ex = _pair((8, 8))
+    y = (ex * 2.0)[0:4]
+    np.testing.assert_allclose(y.glom(), (x * 2.0)[0:4], rtol=1e-6)
+    # slice feeding an expr
+    z = ex[0:4] + ex[4:8]
+    np.testing.assert_allclose(z.glom(), x[0:4] + x[4:8], rtol=1e-6)
+
+
+def test_slice_errors():
+    _, ex = _pair((8, 8))
+    with pytest.raises(IndexError):
+        ex[0, 0, 0]
+    with pytest.raises(IndexError):
+        ex[99]
+
+
+def test_transpose():
+    x, ex = _pair((6, 8))
+    np.testing.assert_array_equal(ex.T.glom(), x.T)
+    np.testing.assert_array_equal(st.transpose(ex, (1, 0)).glom(), x.T)
+    x3 = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    e3 = st.from_numpy(x3)
+    np.testing.assert_array_equal(e3.transpose(2, 0, 1).glom(),
+                                  x3.transpose(2, 0, 1))
+    with pytest.raises(ValueError):
+        st.transpose(ex, (0, 0))
+
+
+def test_reshape_ravel():
+    x, ex = _pair((8, 8))
+    np.testing.assert_array_equal(ex.reshape(4, 16).glom(), x.reshape(4, 16))
+    np.testing.assert_array_equal(ex.reshape(-1, 32).glom(),
+                                  x.reshape(-1, 32))
+    np.testing.assert_array_equal(ex.ravel().glom(), x.ravel())
+    with pytest.raises(ValueError):
+        ex.reshape(3, 5)
+
+
+def test_concatenate():
+    x, ex = _pair((4, 8), seed=1)
+    y, ey = _pair((4, 8), seed=2)
+    np.testing.assert_array_equal(st.concatenate([ex, ey]).glom(),
+                                  np.concatenate([x, y]))
+    np.testing.assert_array_equal(st.concatenate([ex, ey], axis=1).glom(),
+                                  np.concatenate([x, y], axis=1))
+    with pytest.raises(ValueError):
+        st.concatenate([ex, st.from_numpy(np.zeros((3, 3), np.float32))])
+
+
+def test_assign():
+    x, ex = _pair((8, 8))
+    out = st.assign(ex, (slice(0, 2), slice(0, 8)), 7.0).glom()
+    expect = x.copy()
+    expect[0:2] = 7.0
+    np.testing.assert_array_equal(out, expect)
+    # reducer-merge write
+    out2 = st.assign(ex, (slice(0, 8), slice(0, 1)), 1.0, reducer="add")
+    expect2 = x.copy()
+    expect2[:, 0:1] += 1.0
+    np.testing.assert_allclose(out2.glom(), expect2, rtol=1e-6)
+
+
+def test_write_array():
+    data = np.ones((2, 3), np.float32)
+    out = st.write_array((5, 5), (slice(1, 3), slice(2, 5)),
+                         st.from_numpy(data)).glom()
+    expect = np.zeros((5, 5), np.float32)
+    expect[1:3, 2:5] = 1.0
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_boolean_filter():
+    x, ex = _pair((8, 8))
+    mask = x > 0.5
+    out = ex[st.from_numpy(mask)].glom()
+    np.testing.assert_array_equal(out, x[mask])
+    # numpy mask directly
+    np.testing.assert_array_equal(ex[mask].glom(), x[mask])
+
+
+def test_fancy_indexing():
+    x, ex = _pair((10, 4))
+    idx = np.array([0, 3, 3, 9])
+    np.testing.assert_array_equal(ex[idx].glom(), x[idx])
+    neg = np.array([-1, -2])
+    np.testing.assert_array_equal(ex[neg].glom(), x[neg])
+    with pytest.raises(IndexError):
+        ex[np.array([100])].glom()
