@@ -1,0 +1,136 @@
+"""8-process CPU Spartan-equivalent baseline harness.
+
+SURVEY.md §6: "implement the 8-process CPU Spartan-equivalent baseline
+(NumPy tiles) so the 10x target has a measured denominator." This mirrors
+the reference's execution model (SURVEY.md §1 'owner-computes over
+tiles'): a master process partitions arrays into tiles, ships per-tile
+NumPy kernels to worker processes, workers fetch remote operand tiles
+(pickled over pipes — the RPC-serialization cost the reference paid over
+ZeroMQ), compute with NumPy, and send result tiles back for
+reducer-merge/assembly.
+
+Run: python baselines/spartan_cpu_baseline.py  -> writes cpu_baseline.json
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+N_WORKERS = 8
+
+
+def _worker_dot(args):
+    """Per-tile GEMM kernel: receives its A row-tile and the full B
+    (the reference's kernel fetched B tile-rows via blob_ctx.get —
+    SURVEY.md §3.3); returns the C row-tile."""
+    a_tile, b = args
+    return np.dot(a_tile, b)
+
+
+def _worker_map_sum(args):
+    """Config 1 kernel: fused elementwise chain + local sum per tile;
+    partials reducer-merged by the master (SURVEY.md §3.2)."""
+    x_tile, y_tile = args
+    return float(((x_tile + y_tile) * 3.0 - x_tile).sum())
+
+
+def _worker_kmeans(args):
+    """Per-tile k-means kernel: assign + partial sums/counts
+    (SURVEY.md §3.4)."""
+    pts, centers = args
+    d2 = ((pts[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    assign = d2.argmin(1)
+    k = centers.shape[0]
+    sums = np.zeros_like(centers)
+    np.add.at(sums, assign, pts)
+    counts = np.bincount(assign, minlength=k).astype(np.float64)
+    return sums, counts
+
+
+def _row_tiles(x: np.ndarray, n: int) -> List[np.ndarray]:
+    return np.array_split(x, n, axis=0)
+
+
+def bench_dot(pool, n: int = 4096, reps: int = 1) -> Dict:
+    rng = np.random.RandomState(0)
+    a = rng.rand(n, n).astype(np.float32)
+    b = rng.rand(n, n).astype(np.float32)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        tiles = _row_tiles(a, N_WORKERS)
+        # each worker receives (A_tile, B): B is 'fetched' by every
+        # worker exactly as the reference's dot kernel fetched B tiles
+        out = pool.map(_worker_dot, [(t, b) for t in tiles])
+        c = np.concatenate(out, axis=0)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    gflops = 2.0 * n * n * n / best / 1e9
+    assert c.shape == (n, n)
+    return {"seconds": best, "gflops": gflops, "n": n}
+
+
+def bench_map_sum(pool, n: int = 4096, reps: int = 2) -> Dict:
+    rng = np.random.RandomState(1)
+    x = rng.rand(n, n).astype(np.float32)
+    y = rng.rand(n, n).astype(np.float32)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        xt = _row_tiles(x, N_WORKERS)
+        yt = _row_tiles(y, N_WORKERS)
+        partials = pool.map(_worker_map_sum, list(zip(xt, yt)))
+        total = sum(partials)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    # 3 elementwise ops + reduction ≈ 4 flops/element
+    gflops = 4.0 * n * n / best / 1e9
+    return {"seconds": best, "gflops": gflops, "n": n, "result": total}
+
+
+def bench_kmeans(pool, n: int = 125_000, d: int = 128, k: int = 64,
+                 iters: int = 1, target_n: int = 1_000_000) -> Dict:
+    """Measured at n points, linearly extrapolated to target_n (the
+    per-point work is embarrassingly parallel, so the scaling is linear;
+    this box has 1 CPU core, making the full 1M x 128 config impractical
+    to time directly)."""
+    rng = np.random.RandomState(2)
+    pts = rng.rand(n, d).astype(np.float32)
+    centers = pts[rng.choice(n, k, replace=False)].copy()
+    tiles = _row_tiles(pts, N_WORKERS)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pool.map(_worker_kmeans, [(t, centers) for t in tiles])
+        sums = sum(o[0] for o in out)
+        counts = sum(o[1] for o in out)
+        centers = (sums / np.maximum(counts, 1)[:, None]).astype(np.float32)
+    dt = (time.perf_counter() - t0) / iters
+    scale = target_n / n
+    return {"sec_per_iter_measured": dt, "n_measured": n,
+            "sec_per_iter_1m_extrapolated": dt * scale,
+            "iters_per_sec_1m": 1.0 / (dt * scale),
+            "d": d, "k": k, "target_n": target_n}
+
+
+def main() -> None:
+    out_path = os.path.join(os.path.dirname(__file__), "cpu_baseline.json")
+    with mp.Pool(N_WORKERS) as pool:
+        results = {
+            "workers": N_WORKERS,
+            "dot_4096": bench_dot(pool),
+            "map_sum_4096": bench_map_sum(pool),
+            "kmeans_1m": bench_kmeans(pool),
+        }
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

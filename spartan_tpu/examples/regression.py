@@ -1,0 +1,78 @@
+"""Linear / ridge / logistic regression (config 4, BASELINE.json:10;
+reference: ``[U] spartan/examples/`` linear_regression, ridge_regression,
+logistic_regression).
+
+The reference computed per-tile gradients with map + reduce (the gradient
+all-reduce analogue, SURVEY.md §2.6 DP row). Here each SGD step is one
+traced computation over batch-sharded X, y: local matmul + psum gradient
+— the canonical data-parallel pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import spartan_tpu as st
+from ..array import tiling as tiling_mod
+from ..expr.base import Expr, ValExpr, as_expr
+from ..expr.map2 import map2
+
+_REPL1 = tiling_mod.replicated(1)
+
+
+def linear_grad(x: Expr, y: Expr, w: Expr) -> Expr:
+    """d/dw of 0.5*||Xw - y||^2 / n  (lazy)."""
+
+    def kern(xv, yv, wv):
+        err = xv @ wv - yv
+        return xv.T @ err / xv.shape[0]
+
+    return map2([x, y, w], kern, out_tiling=_REPL1)
+
+
+def logistic_grad(x: Expr, y: Expr, w: Expr) -> Expr:
+    """Gradient of mean logistic loss, y in {0,1}."""
+
+    def kern(xv, yv, wv):
+        p = jax.nn.sigmoid(xv @ wv)
+        return xv.T @ (p - yv) / xv.shape[0]
+
+    return map2([x, y, w], kern, out_tiling=_REPL1)
+
+
+def linear_regression(x, y, num_iter: int = 10, lr: float = 1e-2,
+                      ridge: float = 0.0) -> np.ndarray:
+    x, y = as_expr(x), as_expr(y)
+    w: Expr = st.zeros((x.shape[1],), np.float32, tiling=_REPL1)
+    for _ in range(num_iter):
+        g = linear_grad(x, y, w)
+        if ridge:
+            g = g + ridge * w
+        w = ValExpr((w - lr * g).evaluate())
+    return w.glom()
+
+
+def ridge_regression(x, y, num_iter: int = 10, lr: float = 1e-2,
+                     alpha: float = 1.0) -> np.ndarray:
+    return linear_regression(x, y, num_iter, lr, ridge=alpha)
+
+
+def logistic_regression(x, y, num_iter: int = 10, lr: float = 1e-1
+                        ) -> np.ndarray:
+    x, y = as_expr(x), as_expr(y)
+    w: Expr = st.zeros((x.shape[1],), np.float32, tiling=_REPL1)
+    for _ in range(num_iter):
+        g = logistic_grad(x, y, w)
+        w = ValExpr((w - lr * g).evaluate())
+    return w.glom()
+
+
+def predict_logistic(x, w) -> Expr:
+    x, w = as_expr(x), as_expr(w)
+    return map2([x, w], lambda xv, wv: jax.nn.sigmoid(xv @ wv),
+                out_tiling=tiling_mod.Tiling((x.out_tiling().axes[0],)))
+
