@@ -1,0 +1,177 @@
+"""Sparse distributed arrays (COO with static nnz).
+
+Parity with the reference's sparse tiles (SURVEY.md §2.2: ``Tile``
+supports dense / scipy.sparse / masked; §2.5 ``sparse_update.pyx`` merge
+kernel; config 5 needs sparse PageRank / SSVD). TPU-first design per
+SURVEY.md §7 hard part 2: *static* nse (padded), entries sorted by row,
+stored as three device arrays (data, rows, cols) sharded along the entry
+axis. SpMV is ``segment_sum(data * x[cols], rows)`` — the scatter-merge
+runs through :mod:`spartan_tpu.ops.segment` (the Pallas/XLA merge
+kernels), and a BCOO bridge exposes ``jax.experimental.sparse`` fast
+paths. Padding entries carry ``row = nrows`` so every merge drops them
+(XLA segment semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.segment import segment_sum
+from ..parallel import mesh as mesh_mod
+from . import tiling as tiling_mod
+from .distarray import DistArray
+from .tiling import Tiling
+
+
+def _entry_tiling(mesh=None) -> Tiling:
+    """Entries sharded over the whole mesh's row axis."""
+    return tiling_mod.row(1)
+
+
+class SparseDistArray:
+    """A (nrows, ncols) sparse matrix as padded, row-sorted COO device
+    arrays. Immutable; all ops return new arrays or dense DistArrays."""
+
+    def __init__(self, data: jax.Array, rows: jax.Array, cols: jax.Array,
+                 shape: Tuple[int, int], nnz: int,
+                 mesh=None):
+        self.data = data
+        self.rows = rows
+        self.cols = cols
+        self.shape = tuple(int(s) for s in shape)
+        self.nnz = int(nnz)  # true (unpadded) count
+        self.mesh = mesh or mesh_mod.get_mesh()
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def from_coo(rows: Any, cols: Any, data: Any,
+                 shape: Tuple[int, int],
+                 pad_to: Optional[int] = None,
+                 mesh=None) -> "SparseDistArray":
+        rows = np.asarray(rows, np.int32)
+        cols = np.asarray(cols, np.int32)
+        data = np.asarray(data, np.float32)
+        order = np.argsort(rows, kind="stable")
+        rows, cols, data = rows[order], cols[order], data[order]
+        nnz = data.size
+        mesh = mesh or mesh_mod.get_mesh()
+        n_dev = mesh_mod.device_count(mesh)
+        total = pad_to or nnz
+        # pad so the entry axis shards evenly over the mesh
+        total = max(total, nnz)
+        total += -total % max(n_dev, 1)
+        pad = total - nnz
+        if pad:
+            rows = np.pad(rows, (0, pad), constant_values=shape[0])
+            cols = np.pad(cols, (0, pad))
+            data = np.pad(data, (0, pad))
+        sh = _entry_tiling(mesh).sharding(mesh)
+        return SparseDistArray(
+            jax.device_put(data, sh), jax.device_put(rows, sh),
+            jax.device_put(cols, sh), shape, nnz, mesh)
+
+    @staticmethod
+    def from_scipy(mat, mesh=None) -> "SparseDistArray":
+        coo = mat.tocoo()
+        return SparseDistArray.from_coo(coo.row, coo.col, coo.data,
+                                        coo.shape, mesh=mesh)
+
+    @staticmethod
+    def from_dense(arr: Any, mesh=None) -> "SparseDistArray":
+        arr = np.asarray(arr)
+        rows, cols = np.nonzero(arr)
+        return SparseDistArray.from_coo(rows, cols, arr[rows, cols],
+                                        arr.shape, mesh=mesh)
+
+    # -- properties -----------------------------------------------------
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self.data.dtype)
+
+    @property
+    def nse(self) -> int:
+        """Stored (padded) entry count — the static size XLA sees."""
+        return int(self.data.shape[0])
+
+    def __repr__(self) -> str:
+        return (f"SparseDistArray(shape={self.shape}, nnz={self.nnz}, "
+                f"nse={self.nse})")
+
+    # -- conversions ----------------------------------------------------
+
+    def todense(self) -> DistArray:
+        n, m = self.shape
+
+        def fn(data, rows, cols):
+            flat = segment_sum(data, rows * m + cols, n * m)
+            return flat.reshape(n, m)
+
+        # padding entries have row == n, so their flat id n*m falls out
+        # of range and the merge drops them
+        out = jax.jit(fn)(self.data, self.rows, self.cols)
+        return DistArray(out, tiling_mod.default_tiling((n, m), self.mesh),
+                         self.mesh)
+
+    def to_bcoo(self):
+        from jax.experimental import sparse as jsparse
+
+        idx = jnp.stack([self.rows, self.cols], axis=1)
+        return jsparse.BCOO((self.data, idx), shape=self.shape,
+                            indices_sorted=True, unique_indices=True)
+
+    def glom(self) -> np.ndarray:
+        return self.todense().glom()
+
+    # -- ops ------------------------------------------------------------
+
+    def spmv(self, x: Any, impl: Optional[str] = None) -> jax.Array:
+        """y = A @ x for dense x (n,) or (n, d). The gather runs on the
+        entry shards (owner-computes); the row-merge is the segment
+        kernel — GSPMD inserts the psum when entries are sharded."""
+        x = x.jax_array if isinstance(x, DistArray) else jnp.asarray(x)
+        n = self.shape[0]
+
+        def fn(data, rows, cols, xv):
+            gathered = xv[cols]
+            if gathered.ndim == 1:
+                contrib = data * gathered
+            else:
+                contrib = data[:, None] * gathered
+            return segment_sum(contrib, rows, n, impl=impl)
+
+        return jax.jit(fn)(self.data, self.rows, self.cols, x)
+
+    def rsums(self) -> jax.Array:
+        """Row sums (out-degree weights for PageRank)."""
+        return jax.jit(
+            lambda d, r: segment_sum(d, r, self.shape[0]))(
+                self.data, self.rows)
+
+    def transpose(self) -> "SparseDistArray":
+        rows = np.asarray(jax.device_get(self.rows))[:self.nnz]
+        cols = np.asarray(jax.device_get(self.cols))[:self.nnz]
+        data = np.asarray(jax.device_get(self.data))[:self.nnz]
+        return SparseDistArray.from_coo(cols, rows, data,
+                                        (self.shape[1], self.shape[0]),
+                                        mesh=self.mesh)
+
+    @property
+    def T(self) -> "SparseDistArray":
+        return self.transpose()
+
+    def scale_rows(self, scale: Any) -> "SparseDistArray":
+        """Multiply row i's entries by scale[i] (PageRank normalization).
+
+        ``scale`` must have one slot per row; padding entries index
+        ``scale[nrows]`` so it is extended by one zero slot."""
+        scale = jnp.asarray(scale)
+        ext = jnp.concatenate([scale, jnp.zeros((1,), scale.dtype)])
+        data = jax.jit(lambda d, r: d * ext[r])(self.data, self.rows)
+        return SparseDistArray(data, self.rows, self.cols, self.shape,
+                               self.nnz, self.mesh)

@@ -1,0 +1,59 @@
+"""Alternating least squares matrix factorization (reference:
+``[U] spartan/examples/als.py`` / netflix SGD — SURVEY.md §2.4).
+
+R (users x items) ≈ U @ V^T. Each half-step solves all users' (or
+items') k x k normal equations in one batched traced computation —
+``vmap`` over the row dimension replaces the reference's per-tile
+kernel fan-out.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import spartan_tpu as st
+from ..array import tiling as tiling_mod
+from ..expr.base import as_expr
+from ..expr.map2 import map2
+
+
+def _solve_side(r, other, reg):
+    """For each row i of r: solve (O^T W_i O + reg I) f_i = O^T r_i where
+    W_i masks observed entries (r != 0)."""
+
+    def per_row(r_row):
+        w = (r_row != 0).astype(r_row.dtype)
+        a = (other.T * w) @ other + reg * jnp.eye(other.shape[1],
+                                                 dtype=r_row.dtype)
+        b = other.T @ (w * r_row)
+        return jnp.linalg.solve(a, b)
+
+    return jax.vmap(per_row)(r)
+
+
+def als(ratings, k: int = 8, num_iter: int = 10, reg: float = 0.1,
+        seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Factor a (possibly zero-masked) ratings matrix; zeros = missing."""
+    ratings = as_expr(ratings)
+    m, n = ratings.shape
+    rng = np.random.RandomState(seed)
+    v = rng.rand(n, k).astype(np.float32) * 0.1
+
+    r_rows = ratings  # (m, n) row-sharded
+    r_cols = ratings.T  # lazy transpose -> (n, m)
+
+    u = None
+    for _ in range(num_iter):
+        ev = st.from_numpy(v, tiling=tiling_mod.replicated(2))
+        u = map2([r_rows, ev],
+                 lambda rv, vv: _solve_side(rv, vv, reg),
+                 out_tiling=tiling_mod.row(2)).glom()
+        eu = st.from_numpy(u, tiling=tiling_mod.replicated(2))
+        v = map2([r_cols, eu],
+                 lambda rv, uv: _solve_side(rv, uv, reg),
+                 out_tiling=tiling_mod.row(2)).glom()
+    return u, v

@@ -1,0 +1,42 @@
+"""Conjugate gradient solver (reference: ``[U]
+spartan/examples/conj_gradient.py`` — SURVEY.md §2.4).
+
+Each CG step is a handful of lazy exprs (one SpMV-shaped dot + axpys +
+two inner products); the whole update forces as one compiled program and
+the driver loop hits the structural cache.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import spartan_tpu as st
+from ..expr.base import Expr, ValExpr, as_expr
+
+
+def conj_gradient(a, b, num_iter: int = 20, tol: float = 1e-6
+                  ) -> np.ndarray:
+    """Solve A x = b for SPD A."""
+    a = as_expr(a)
+    b = as_expr(b)
+    n = b.shape[0]
+    x = st.zeros((n,), np.float32)
+    r = ValExpr((b - st.dot(a, x)).evaluate())
+    p = r
+    rs_old = float((r * r).sum().glom())
+    for _ in range(num_iter):
+        ap = st.dot(a, p)
+        denom = float((p * ap).sum().glom())
+        if abs(denom) < 1e-30:
+            break
+        alpha = rs_old / denom
+        x = ValExpr((x + alpha * p).evaluate())
+        r = ValExpr((r - alpha * ap).evaluate())
+        rs_new = float((r * r).sum().glom())
+        if np.sqrt(rs_new) < tol:
+            break
+        p = ValExpr((r + (rs_new / rs_old) * p).evaluate())
+        rs_old = rs_new
+    return x.glom()

@@ -1,0 +1,49 @@
+"""Fuzzy k-means (reference: ``[U] spartan/examples/fuzzy_kmeans.py`` —
+SURVEY.md §2.4). Soft assignments with fuzziness m; each iteration is one
+traced computation: membership weights + weighted center accumulation
+(the reducer-merge becomes a psum over the batch axis)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import spartan_tpu as st
+from ..array import tiling as tiling_mod
+from ..expr.base import Expr, ValExpr, as_expr
+from ..expr.map2 import map2
+
+
+def fuzzy_kmeans_step(points: Expr, centers: Expr, k: int,
+                      m: float = 2.0) -> Expr:
+    def kern(p, c):
+        d2 = (jnp.sum(p * p, 1, keepdims=True) - 2.0 * p @ c.T
+              + jnp.sum(c * c, 1)[None, :])
+        d2 = jnp.maximum(d2, 1e-12)
+        inv = d2 ** (-1.0 / (m - 1.0))
+        u = inv / inv.sum(axis=1, keepdims=True)  # memberships (n, k)
+        um = u ** m
+        sums = um.T @ p  # (k, d) weighted sums
+        wsum = um.sum(axis=0)  # (k,)
+        return jnp.concatenate([sums, wsum[:, None]], axis=1)
+
+    acc = map2([points, centers], kern,
+               out_tiling=tiling_mod.replicated(2))
+    sums = acc[:, :-1]
+    w = acc[:, -1:]
+    return sums / st.maximum(w, 1e-12)
+
+
+def fuzzy_kmeans(points, k: int, num_iter: int = 10, m: float = 2.0,
+                 seed: int = 0) -> np.ndarray:
+    points = as_expr(points)
+    n, d = points.shape
+    rng = np.random.RandomState(seed)
+    centers: Expr = as_expr(
+        points[np.sort(rng.choice(n, k, replace=False))].glom())
+    for _ in range(num_iter):
+        centers = ValExpr(
+            fuzzy_kmeans_step(points, centers, k, m).evaluate())
+    return centers.glom()

@@ -1,0 +1,52 @@
+"""Multinomial naive Bayes (reference: ``[U]
+spartan/examples/naive_bayes.py`` — SURVEY.md §2.4).
+
+Fitting is one segment-sum of feature counts by class (the reference's
+shuffle/reduce merge) + log-prior/likelihood tables; prediction is a
+replicated table matmul over the batch-sharded features.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import spartan_tpu as st
+from ..array import tiling as tiling_mod
+from ..expr.base import Expr, as_expr
+from ..expr.map2 import map2
+from ..ops.segment import segment_count, segment_sum
+
+
+def fit(x, y, n_classes: int, alpha: float = 1.0
+        ) -> Tuple[np.ndarray, np.ndarray]:
+    """x: (n, d) nonnegative counts; y: (n,) int labels.
+    Returns (log_prior (c,), log_likelihood (c, d))."""
+    x, y = as_expr(x), as_expr(y)
+
+    def kern(xv, yv):
+        counts = segment_sum(xv, yv, n_classes)
+        class_n = segment_count(yv, n_classes, dtype=xv.dtype)
+        return jnp.concatenate([counts, class_n[:, None]], axis=1)
+
+    packed = map2([x, y], kern,
+                  out_tiling=tiling_mod.replicated(2)).glom()
+    counts = packed[:, :-1]
+    class_n = packed[:, -1]
+    smoothed = counts + alpha
+    log_lik = np.log(smoothed / smoothed.sum(axis=1, keepdims=True))
+    log_prior = np.log(np.maximum(class_n, 1e-12) / class_n.sum())
+    return log_prior.astype(np.float32), log_lik.astype(np.float32)
+
+
+def predict(x, log_prior: np.ndarray, log_lik: np.ndarray) -> Expr:
+    x = as_expr(x)
+    ep = st.from_numpy(log_prior, tiling=tiling_mod.replicated(1))
+    el = st.from_numpy(log_lik, tiling=tiling_mod.replicated(2))
+    return map2([x, ep, el],
+                lambda xv, pv, lv: jnp.argmax(xv @ lv.T + pv[None, :],
+                                              axis=1),
+                out_tiling=tiling_mod.Tiling((x.out_tiling().axes[0],)))

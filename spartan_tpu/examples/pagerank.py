@@ -1,0 +1,41 @@
+"""Sparse PageRank (config 5, BASELINE.json:11; reference:
+``[U] spartan/examples/pagerank.py``).
+
+The reference iterated rank = d * A^T rank + (1-d)/n with per-tile sparse
+kernels and shuffle merges. Here A^T is a :class:`SparseDistArray`; each
+power iteration is one jitted SpMV (gather on the entry shards +
+segment-merge) plus the teleport term.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..array.sparse import SparseDistArray
+
+
+def pagerank(links: SparseDistArray, damping: float = 0.85,
+             num_iter: int = 20, tol: float = 0.0) -> np.ndarray:
+    """links[i, j] != 0 means page i links to page j. Returns ranks."""
+    n = links.shape[0]
+    # column-stochastic transition: T = (A / outdegree)^T
+    out_deg = np.asarray(jax.device_get(links.rsums()))
+    inv = np.where(out_deg > 0, 1.0 / np.maximum(out_deg, 1e-30), 0.0)
+    T = links.scale_rows(inv.astype(np.float32)).transpose()
+
+    rank = jnp.full((n,), 1.0 / n, jnp.float32)
+    teleport = (1.0 - damping) / n
+    for _ in range(num_iter):
+        new = damping * T.spmv(rank) + teleport
+        # dangling mass: pages with no outlinks redistribute uniformly
+        dangling = 1.0 - float(new.sum())
+        new = new + dangling / n
+        if tol > 0 and float(jnp.abs(new - rank).sum()) < tol:
+            rank = new
+            break
+        rank = new
+    return np.asarray(jax.device_get(rank))

@@ -1,0 +1,61 @@
+"""Stochastic (randomized) SVD (config 5, BASELINE.json:11; reference:
+``[U] spartan/examples/ssvd.py``, after Halko-Martinsson-Tropp).
+
+The reference built the sketch Y = A @ Omega with shuffle-GEMM and ran
+per-tile QR assembly. Here the sketch, power iterations, projection and
+the small final SVD are traced dense ops: the big GEMMs ride the sharded
+dot path (MXU) and the (n, k) panel QR runs replicated (k is small).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import spartan_tpu as st
+from ..expr.base import Expr, as_expr
+from ..expr.map2 import map2
+from ..array import tiling as tiling_mod
+
+
+def ssvd(a, rank: int, n_oversample: int = 10, n_power_iter: int = 2,
+         seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Approximate truncated SVD: returns (U, s, Vt) with U (m, rank)."""
+    a = as_expr(a)
+    m, n = a.shape
+    k = min(rank + n_oversample, min(m, n))
+
+    rng = np.random.RandomState(seed)
+    omega = st.from_numpy(rng.randn(n, k).astype(np.float32),
+                          tiling=tiling_mod.replicated(2))
+
+    # sketch + power iterations, QR-stabilized each hop
+    def qr_q(x):
+        return jnp.linalg.qr(x)[0]
+
+    y = st.dot(a, omega)
+    q = map2([y], qr_q, out_tiling=tiling_mod.row(2))
+    for _ in range(n_power_iter):
+        z = st.dot(a.T, q)
+        qz = map2([z], qr_q, out_tiling=tiling_mod.row(2))
+        y = st.dot(a, qz)
+        q = map2([y], qr_q, out_tiling=tiling_mod.row(2))
+
+    # project to the small space and decompose there
+    b = st.dot(q.T, a)  # (k, n)
+
+    def small_svd(bv):
+        u_b, s, vt = jnp.linalg.svd(bv, full_matrices=False)
+        return jnp.concatenate([u_b, s[None, :], vt.T], axis=0)
+
+    packed = map2([b], small_svd,
+                  out_tiling=tiling_mod.replicated(2)).glom()
+    u_b = packed[:k]
+    s = packed[k]
+    vt = packed[k + 1:].T
+
+    u = st.dot(q, st.from_numpy(u_b)).glom()
+    return u[:, :rank], s[:rank], vt[:rank]

@@ -1,0 +1,47 @@
+"""Linear SVM via primal sub-gradient descent (reference:
+``[U] spartan/examples/svm.py`` — SURVEY.md §2.4).
+
+Hinge-loss gradient over the batch-sharded data; one step = one traced
+computation with a psum'd gradient (the DP pattern of SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import spartan_tpu as st
+from ..array import tiling as tiling_mod
+from ..expr.base import Expr, ValExpr, as_expr
+from ..expr.map2 import map2
+
+_REPL1 = tiling_mod.replicated(1)
+
+
+def svm_grad(x: Expr, y: Expr, w: Expr, reg: float) -> Expr:
+    """y in {-1, +1}; sub-gradient of mean hinge loss + L2."""
+
+    def kern(xv, yv, wv):
+        margin = yv * (xv @ wv)
+        active = (margin < 1.0).astype(xv.dtype)
+        g = -(xv.T @ (active * yv)) / xv.shape[0]
+        return g + reg * wv
+
+    return map2([x, y, w], kern, out_tiling=_REPL1)
+
+
+def svm(x, y, num_iter: int = 100, lr: float = 0.1, reg: float = 1e-3
+        ) -> np.ndarray:
+    x, y = as_expr(x), as_expr(y)
+    w: Expr = st.zeros((x.shape[1],), np.float32, tiling=_REPL1)
+    for _ in range(num_iter):
+        g = svm_grad(x, y, w, reg)
+        w = ValExpr((w - lr * g).evaluate())
+    return w.glom()
+
+
+def predict(x, w) -> Expr:
+    x, w = as_expr(x), as_expr(w)
+    return map2([x, w], lambda xv, wv: jnp.sign(xv @ wv),
+                out_tiling=tiling_mod.Tiling((x.out_tiling().axes[0],)))

@@ -1,7 +1,8 @@
 """Public lazy-expression API (the reference's ``spartan.expr`` surface)."""
 
-from .base import (Expr, ScalarExpr, ValExpr, as_expr, clear_compile_cache,
-                   compile_cache_size, evaluate, lazify)
+from .base import (Expr, ScalarExpr, TupleExpr, ValExpr, as_expr,
+                   clear_compile_cache, compile_cache_size, evaluate, lazify,
+                   tuple_of)
 from .builtins import *  # noqa: F401,F403
 from .builtins import __all__ as _builtin_all
 from .assign import WriteExpr, assign, write_array
@@ -18,7 +19,8 @@ from .reshape import (ConcatExpr, ReshapeExpr, TransposeExpr, concatenate,
 from .shuffle import shuffle
 from .slice import SliceExpr, make_slice
 
-__all__ = ["Expr", "ValExpr", "ScalarExpr", "as_expr", "lazify", "evaluate",
+__all__ = ["Expr", "ValExpr", "ScalarExpr", "TupleExpr", "tuple_of",
+           "as_expr", "lazify", "evaluate",
            "optimize", "dag_nodes", "map", "map_with_location", "MapExpr",
            "ReduceExpr", "GeneralReduceExpr", "CreateExpr", "RandomExpr",
            "compile_cache_size", "clear_compile_cache",

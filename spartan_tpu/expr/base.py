@@ -376,6 +376,53 @@ def lazify(value: Any) -> Expr:
     return as_expr(value)
 
 
+class TupleExpr(Expr):
+    """Multiple roots evaluated in ONE jitted program (the reference's
+    ``TupleExpr``/``ListExpr`` — SURVEY.md §2.3). ``glom()``/``evaluate()``
+    return tuples; elements may have different shapes/dtypes/tilings."""
+
+    def __init__(self, elements: Sequence[Expr]):
+        self.elements: Tuple[Expr, ...] = tuple(as_expr(e) for e in elements)
+        if not self.elements:
+            raise ValueError("TupleExpr needs at least one element")
+        super().__init__((), self.elements[0].dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return self.elements
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> "TupleExpr":
+        return TupleExpr(new_children)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        return tuple(e.lower(env) for e in self.elements)
+
+    def _sig(self, ctx: "_SigCtx") -> Tuple:
+        return ("tuple",) + tuple(ctx.of(e) for e in self.elements)
+
+    def out_tilings(self) -> Tuple[Tiling, ...]:
+        return tuple(tiling_mod.sanitize(e.out_tiling(), e.shape)
+                     for e in self.elements)
+
+    def _default_tiling(self) -> Tiling:
+        return tiling_mod.replicated(0)
+
+    def __len__(self) -> int:
+        return len(self.elements)
+
+    def evaluate(self) -> Tuple[DistArray, ...]:  # type: ignore[override]
+        return evaluate(self)
+
+    def force(self) -> Tuple[DistArray, ...]:  # type: ignore[override]
+        return evaluate(self)
+
+    def glom(self):  # type: ignore[override]
+        return tuple(r.glom() for r in evaluate(self))
+
+
+def tuple_of(*elements: Any) -> TupleExpr:
+    return TupleExpr(elements)
+
+
 # -- evaluation machinery ----------------------------------------------
 
 
@@ -444,22 +491,31 @@ def evaluate(expr: Expr) -> DistArray:
     root_sig = ctx.of(dag)
     leaves = ctx.leaves
     mesh = mesh_mod.get_mesh()
-    out_tiling = tiling_mod.sanitize(dag.out_tiling(), dag.shape, mesh)
-    key = (root_sig, out_tiling.axes,
+    is_tuple = isinstance(dag, TupleExpr)
+    if is_tuple:
+        out_tilings = dag.out_tilings()
+    else:
+        out_tilings = (tiling_mod.sanitize(dag.out_tiling(), dag.shape,
+                                           mesh),)
+    key = (root_sig, tuple(t.axes for t in out_tilings),
            tuple(sorted(mesh.shape.items())))
 
     with _cache_lock:
         jitted = _compile_cache.get(key)
     if jitted is None:
         leaf_ids = tuple(l._id for l in leaves)
-        out_sharding = out_tiling.sharding(mesh)
+        out_shardings = tuple(t.sharding(mesh) for t in out_tilings)
 
         def traced(*args: Any) -> Any:
             env: Dict[int, Any] = dict(zip(leaf_ids, args))
             out = dag.lower(env)
             # a constraint (not jit out_shardings) so GSPMD propagation can
             # negotiate ops like reverse that hard-fail on output overrides
-            return jax.lax.with_sharding_constraint(out, out_sharding)
+            if is_tuple:
+                return tuple(
+                    jax.lax.with_sharding_constraint(o, s)
+                    for o, s in zip(out, out_shardings))
+            return jax.lax.with_sharding_constraint(out, out_shardings[0])
 
         jitted = jax.jit(traced)
         with _cache_lock:
@@ -472,12 +528,18 @@ def evaluate(expr: Expr) -> DistArray:
 
     args = [_leaf_arg(l) for l in leaves]
     out = jitted(*args)
-    result = DistArray(out, out_tiling, mesh)
+    if is_tuple:
+        result: Any = tuple(DistArray(o, t, mesh)
+                            for o, t in zip(out, out_tilings))
+    else:
+        result = DistArray(out, out_tilings[0], mesh)
 
     if FLAGS.check_determinism:
         out2 = jitted(*args)
-        if not bool(jnp.all(out == out2)):
-            raise AssertionError("nondeterministic evaluation detected")
+        pairs = zip(out, out2) if is_tuple else [(out, out2)]
+        for o1, o2 in pairs:
+            if not bool(jnp.all(o1 == o2)):
+                raise AssertionError("nondeterministic evaluation detected")
 
     expr._result = result
     dag._result = result

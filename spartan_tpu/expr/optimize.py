@@ -66,7 +66,10 @@ class CollapseCachedPass(Pass):
 
     def run(self, root: Expr) -> Expr:
         def visit(n: Expr, kids: Tuple[Expr, ...]) -> Expr:
-            if n._result is not None and not isinstance(n, ValExpr):
+            from ..array.distarray import DistArray
+
+            if (isinstance(n._result, DistArray)
+                    and not isinstance(n, ValExpr)):
                 return ValExpr(n._result)
             return default_visit(n, kids)
 

@@ -1,0 +1,147 @@
+"""Application smoke tests (SURVEY.md §4: run a few iterations on
+synthetic data; check convergence/shape, not exact values)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def test_kmeans_converges():
+    from spartan_tpu.examples.kmeans import kmeans
+
+    rng = np.random.RandomState(0)
+    pts = np.concatenate([rng.randn(64, 4) + 5,
+                          rng.randn(64, 4) - 5]).astype(np.float32)
+    centers, assign = kmeans(st.from_numpy(pts), k=2, num_iter=5)
+    assert centers.shape == (2, 4)
+    assert sorted(np.round(centers[:, 0]).astype(int).tolist()) == [-5, 5]
+    assert np.bincount(assign).tolist() == [64, 64]
+
+
+def test_linear_regression():
+    from spartan_tpu.examples.regression import linear_regression
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(256, 8).astype(np.float32)
+    w_true = rng.randn(8).astype(np.float32)
+    y = X @ w_true
+    w = linear_regression(st.from_numpy(X), st.from_numpy(y),
+                          num_iter=200, lr=0.1)
+    np.testing.assert_allclose(w, w_true, atol=1e-2)
+
+
+def test_logistic_regression():
+    from spartan_tpu.examples.regression import logistic_regression
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(256, 8).astype(np.float32)
+    w_true = rng.randn(8).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    w = logistic_regression(st.from_numpy(X), st.from_numpy(y),
+                            num_iter=100, lr=0.5)
+    acc = (((X @ w) > 0) == y).mean()
+    assert acc > 0.95
+
+
+def test_svm():
+    from spartan_tpu.examples.svm import svm
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(256, 4).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 0.5, 1.5], np.float32)
+    y = np.sign(X @ w_true).astype(np.float32)
+    w = svm(st.from_numpy(X), st.from_numpy(y), num_iter=150, lr=0.1)
+    acc = (np.sign(X @ w) == y).mean()
+    assert acc > 0.95
+
+
+def test_naive_bayes():
+    from spartan_tpu.examples.naive_bayes import fit, predict
+
+    rng = np.random.RandomState(4)
+    n_per, d = 128, 12
+    # class 0 heavy on first features, class 1 on last
+    x0 = rng.poisson(5, (n_per, d)) * np.r_[np.ones(6), np.ones(6) * 0.2]
+    x1 = rng.poisson(5, (n_per, d)) * np.r_[np.ones(6) * 0.2, np.ones(6)]
+    X = np.concatenate([x0, x1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_per), np.ones(n_per)]).astype(np.int32)
+    lp, ll = fit(st.from_numpy(X), st.from_numpy(y), n_classes=2)
+    pred = predict(st.from_numpy(X), lp, ll).glom()
+    assert (pred == y).mean() > 0.9
+
+
+def test_fuzzy_kmeans():
+    from spartan_tpu.examples.fuzzy_kmeans import fuzzy_kmeans
+
+    rng = np.random.RandomState(5)
+    pts = np.concatenate([rng.randn(64, 2) + 4,
+                          rng.randn(64, 2) - 4]).astype(np.float32)
+    centers = fuzzy_kmeans(st.from_numpy(pts), k=2, num_iter=15)
+    assert sorted(np.round(centers[:, 0] / 4).astype(int).tolist()) == [-1, 1]
+
+
+def test_conj_gradient():
+    from spartan_tpu.examples.conj_gradient import conj_gradient
+
+    rng = np.random.RandomState(6)
+    m = rng.randn(16, 16).astype(np.float32)
+    a = m @ m.T + 16 * np.eye(16, dtype=np.float32)
+    x_true = rng.randn(16).astype(np.float32)
+    b = a @ x_true
+    x = conj_gradient(st.from_numpy(a), st.from_numpy(b), num_iter=32)
+    np.testing.assert_allclose(x, x_true, atol=1e-2, rtol=1e-2)
+
+
+def test_als():
+    from spartan_tpu.examples.als import als
+
+    rng = np.random.RandomState(7)
+    u_true = rng.rand(24, 4).astype(np.float32)
+    v_true = rng.rand(16, 4).astype(np.float32)
+    r = u_true @ v_true.T
+    mask = rng.rand(24, 16) < 0.7
+    r_obs = (r * mask).astype(np.float32)
+    u, v = als(st.from_numpy(r_obs), k=4, num_iter=8, reg=0.05)
+    recon = u @ v.T
+    err = np.abs(recon[mask] - r[mask]).mean()
+    assert err < 0.05
+
+
+def test_pagerank():
+    from spartan_tpu.array.sparse import SparseDistArray
+    from spartan_tpu.examples.pagerank import pagerank
+
+    # star graph: everyone links to node 0; node 0 links to node 1
+    n = 8
+    rows = np.arange(1, n)
+    cols = np.zeros(n - 1, np.int64)
+    rows = np.concatenate([rows, [0]])
+    cols = np.concatenate([cols, [1]])
+    links = SparseDistArray.from_coo(rows, cols,
+                                     np.ones(n, np.float32), (n, n))
+    ranks = pagerank(links, num_iter=40)
+    assert ranks.argmax() == 0
+    assert ranks[1] > ranks[2]  # node 1 gets node 0's rank
+    np.testing.assert_allclose(ranks.sum(), 1.0, rtol=1e-3)
+
+
+def test_ssvd():
+    from spartan_tpu.examples.ssvd import ssvd
+
+    rng = np.random.RandomState(8)
+    # low-rank + noise
+    a = (rng.randn(32, 6) @ rng.randn(6, 24)).astype(np.float32)
+    u, s, vt = ssvd(st.from_numpy(a), rank=6, n_power_iter=2)
+    assert u.shape == (32, 6) and s.shape == (6,) and vt.shape == (6, 24)
+    recon = u @ np.diag(s) @ vt
+    rel = np.linalg.norm(recon - a) / np.linalg.norm(a)
+    assert rel < 1e-3
+    s_true = np.linalg.svd(a, compute_uv=False)[:6]
+    np.testing.assert_allclose(s, s_true, rtol=1e-3)
