@@ -76,7 +76,13 @@ class Expr:
 
     def lower(self, env: Dict[int, Any]) -> Any:
         if self._id not in env:
-            env[self._id] = self._lower(env)
+            val = self._lower(env)
+            if self._forced_tiling is not None:
+                # smart-tiling chose this node's layout: constrain it so
+                # GSPMD materializes the planned resharding points
+                val = jax.lax.with_sharding_constraint(
+                    val, self._forced_tiling.sharding(mesh_mod.get_mesh()))
+            env[self._id] = val
         return env[self._id]
 
     def _sig(self, ctx: "_SigCtx") -> Tuple:
@@ -449,6 +455,8 @@ class _SigCtx:
             # so diamond DAGs don't blow up exponentially
             return ("ref", self._visit[node._id])
         sig = node._sig(self)
+        if node._forced_tiling is not None:
+            sig = sig + ("forced", node._forced_tiling.axes)
         self._visit[node._id] = len(self._memo)
         self._memo[node._id] = sig
         return sig

@@ -1,15 +1,175 @@
-"""ICI-cost model for the smart-tiling pass.
+"""Smart-tiling: ICI-cost-driven sharding assignment.
 
-Skeleton for SURVEY.md §7 step 6; currently assigns nothing (each node's
-``_default_tiling`` propagation stands). The full candidate/cost search
-lands with the dot and shuffle layers, where resharding cost actually
-bites.
+The reference's headline optimization (SURVEY.md §2.3 pass (d), ATC'15
+"smart tiling"): per-array candidate tilings, edge costs = bytes moved
+between producer and consumer tilings, min-cost assignment via a greedy
+DP. Re-targeted per SURVEY.md §7 step 6: candidates are mesh shardings
+(row / col / block / replicated), an edge's cost is the bytes a
+resharding collective moves over ICI, and compute cost rewards sharded
+layouts (owner-computes parallelism). The result is written as
+``_forced_tiling`` on DAG nodes, which ``Expr.lower`` turns into
+``with_sharding_constraint``s — so the choice actually shapes the XLA
+program, and the FLAGS toggle (``opt_auto_tiling``) A/Bs it.
+
+Cost model (per-chip bytes, ring collectives over n devices):
+  * same tiling, or source replicated: 0
+  * sharded -> replicated (all-gather): size * (n-1)/n
+  * sharded -> differently sharded (all-to-all): size * (n-1)/n
+  * compute: size * C / p, where p = devices the tiling spreads over
+    (owner-computes speedup), C weights FLOP cost against ICI bytes.
 """
 
 from __future__ import annotations
 
-from .base import Expr
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from ..parallel import mesh as mesh_mod
+from .base import Expr, ScalarExpr, TupleExpr, ValExpr
+from .map import MapExpr
+from .reduce import GeneralReduceExpr, ReduceExpr
+from .reshape import TransposeExpr
+from .slice import SliceExpr
+
+_COMPUTE_WEIGHT = 4.0  # bytes-equivalent per element of local compute
+
+
+def _mesh_n(mesh) -> int:
+    return mesh_mod.device_count(mesh)
+
+
+def _parallelism(t: Tiling, mesh) -> int:
+    p = 1
+    for n in t.tiles_per_dim(mesh):
+        p *= n
+    return p
+
+
+def candidates(node: Expr, mesh) -> List[Tiling]:
+    """Candidate output tilings for a node (divisible ones only)."""
+    nd = node.ndim
+    cands = {tiling_mod.replicated(nd)}
+    if nd >= 1:
+        cands.add(tiling_mod.row(nd))
+    if nd >= 2:
+        cands.add(tiling_mod.col(nd))
+        cands.add(tiling_mod.block(nd))
+    out = []
+    for t in cands:
+        if tiling_mod.sanitize(t, node.shape, mesh) == t:
+            out.append(t)
+    return out or [tiling_mod.replicated(nd)]
+
+
+def reshard_cost(src: Tiling, dst: Tiling, nbytes: float, mesh) -> float:
+    if src.axes == dst.axes:
+        return 0.0
+    if not src.sharded_axes():  # replicated source: local slicing only
+        return 0.0
+    n = _mesh_n(mesh)
+    return nbytes * (n - 1) / max(n, 1)
+
+
+def _operand_requirement(node: Expr, t: Tiling, child: Expr,
+                         child_idx: int) -> Optional[Tiling]:
+    """The operand tiling node wants from ``child`` when producing ``t``.
+    None = no preference (child keeps its own best; GSPMD negotiates)."""
+    if isinstance(node, MapExpr):
+        if child.shape == node.shape:
+            return t
+        return tiling_mod.replicated(child.ndim)  # broadcast operand
+    if isinstance(node, (ReduceExpr, GeneralReduceExpr)):
+        if node.axis is None:
+            return None  # full reduction reads any layout equally
+        t_in = t
+        if not (isinstance(node, ReduceExpr) and node.keepdims):
+            for a in node.axis:
+                t_in = t_in.add_axis(a, None)
+        return t_in
+    if isinstance(node, TransposeExpr):
+        inv = np.argsort(node.perm)
+        return t.transpose(tuple(int(i) for i in inv))
+    if isinstance(node, SliceExpr):
+        return None
+    from .dot import DotExpr
+
+    if isinstance(node, DotExpr) and node.a.ndim == 2 and node.b.ndim == 2:
+        # the lowering constrains operands itself (row x col)
+        return tiling_mod.row(2) if child_idx == 0 else tiling_mod.col(2)
+    return None
 
 
 def assign_tilings(root: Expr) -> Expr:
+    mesh = mesh_mod.get_mesh()
+    if _mesh_n(mesh) <= 1:
+        return root  # single device: everything is replicated anyway
+
+    # cost_table[node_id][tiling] = (cost, per-child chosen tilings)
+    table: Dict[int, Dict[Tiling, Tuple[float, Tuple]] ] = {}
+
+    def nbytes(e: Expr) -> float:
+        return float(e.size) * e.dtype.itemsize
+
+    def build(node: Expr) -> None:
+        if node._id in table:
+            return
+        for c in node.children():
+            build(c)
+        entries: Dict[Tiling, Tuple[float, Tuple]] = {}
+        if isinstance(node, (ValExpr, ScalarExpr)):
+            entries[node.out_tiling()] = (0.0, ())
+            table[node._id] = entries
+            return
+        kids = node.children()
+        for t in candidates(node, mesh):
+            comm = 0.0
+            picks: List[Tiling] = []
+            for i, c in enumerate(kids):
+                req = _operand_requirement(node, t, c, i)
+                best_cost = None
+                best_pick = None
+                for tc, (ccost, _) in table[c._id].items():
+                    move = (0.0 if req is None
+                            else reshard_cost(tc, req, nbytes(c), mesh))
+                    total = ccost + move
+                    if best_cost is None or total < best_cost:
+                        best_cost, best_pick = total, tc
+                comm += best_cost or 0.0
+                picks.append(best_pick)
+            compute = (nbytes(node) * _COMPUTE_WEIGHT
+                       / _parallelism(t, mesh))
+            entries[t] = (comm + compute, tuple(picks))
+        table[node._id] = entries
+
+    def commit(node: Expr, t: Tiling) -> None:
+        if isinstance(node, (ValExpr, ScalarExpr)):
+            return
+        if node._forced_tiling is None and t is not None:
+            node._forced_tiling = t
+        entry = table[node._id].get(t)
+        if entry is None:
+            return
+        for c, tc in zip(node.children(), entry[1]):
+            if tc is not None:
+                commit(c, tc)
+
+    roots = root.elements if isinstance(root, TupleExpr) else (root,)
+    for r in roots:
+        build(r)
+        best_t = min(table[r._id], key=lambda t: table[r._id][t][0])
+        commit(r, best_t)
     return root
+
+
+def explain(root: Expr) -> str:
+    """Debug dump of chosen tilings (for the ablation reports)."""
+    from .optimize import dag_nodes
+
+    lines = []
+    for n in dag_nodes(root):
+        lines.append(f"{type(n).__name__}#{n._id} shape={n.shape} "
+                     f"tiling={n.out_tiling().axes}")
+    return "\n".join(lines)
