@@ -196,14 +196,45 @@ def intersection(a: TileExtent, b: TileExtent) -> Optional[TileExtent]:
     return a.intersection(b)
 
 
+# batch sizes below this stay in pure Python (ctypes call overhead)
+_NATIVE_THRESHOLD = 64
+
+
+def _use_native(n: int) -> bool:
+    from ..utils.config import FLAGS
+
+    if n < _NATIVE_THRESHOLD or not FLAGS.use_cpp_extent:
+        return False
+    from .. import native
+
+    return native.lib() is not None
+
+
+def _pack(extents: Sequence[TileExtent]):
+    uls = np.asarray([e.ul for e in extents], np.int64)
+    lrs = np.asarray([e.lr for e in extents], np.int64)
+    return uls, lrs
+
+
 def find_overlapping(extents: Sequence[TileExtent],
                      region: TileExtent) -> List[TileExtent]:
     """All extents intersecting ``region`` (the tile-lookup primitive used
-    by region fetch/update)."""
+    by region fetch/update). Large batches go through the C++ twin."""
+    if _use_native(len(extents)):
+        from .. import native
+
+        uls, lrs = _pack(extents)
+        mask, _, _ = native.intersect_batch(uls, lrs, region.ul, region.lr)
+        return [e for e, hit in zip(extents, mask) if hit]
     return [e for e in extents if e.intersection(region) is not None]
 
 
 def all_nonoverlapping(extents: Sequence[TileExtent]) -> bool:
+    if _use_native(len(extents)):
+        from .. import native
+
+        uls, lrs = _pack(extents)
+        return not native.any_overlap(uls, lrs)
     for i, a in enumerate(extents):
         for b in extents[i + 1:]:
             if a.intersection(b) is not None:

@@ -1,0 +1,174 @@
+// Native host-runtime components for spartan_tpu.
+//
+// TPU-native equivalents of the reference's Cython extensions
+// (SURVEY.md §2.5):
+//   * extent batch algebra  <- fast region math (the possible Cython
+//     extent twin): batched intersection / overlap masks / coverage
+//     checks used by the metadata plane (shuffle planning, fetch
+//     assembly) where Python-level loops are O(n^2).
+//   * parallel blob IO      <- serialization_buffer.pyx's role on the
+//     host side: the device data path is XLA, so the native surface
+//     that matters is moving checkpoint shards between pinned host
+//     buffers and disk without Python overhead; a std::thread pool
+//     writes/reads all shards of a DistArray concurrently.
+//
+// Exposed as a C ABI for ctypes (pybind11 is not in this image).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread
+//        spartan_native.cpp -o libspartan_native.so
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Extent algebra (half-open boxes [ul, lr) of rank nd, int64 coords)
+// ---------------------------------------------------------------------
+
+// Intersect every box i with the query box; out_ul/out_lr receive the
+// intersection (undefined where empty); out_mask[i] = 1 if non-empty.
+// Returns number of non-empty intersections.
+int64_t extent_intersect_batch(const int64_t* uls, const int64_t* lrs,
+                               int64_t n, int64_t nd,
+                               const int64_t* q_ul, const int64_t* q_lr,
+                               int64_t* out_ul, int64_t* out_lr,
+                               uint8_t* out_mask) {
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t* ul = uls + i * nd;
+    const int64_t* lr = lrs + i * nd;
+    int64_t* oul = out_ul + i * nd;
+    int64_t* olr = out_lr + i * nd;
+    uint8_t ok = 1;
+    for (int64_t d = 0; d < nd; ++d) {
+      int64_t a = ul[d] > q_ul[d] ? ul[d] : q_ul[d];
+      int64_t b = lr[d] < q_lr[d] ? lr[d] : q_lr[d];
+      oul[d] = a;
+      olr[d] = b;
+      if (a >= b) ok = 0;
+    }
+    out_mask[i] = ok;
+    hits += ok;
+  }
+  return hits;
+}
+
+// Pairwise overlap test over n boxes: returns 1 if ANY pair overlaps
+// (the all_nonoverlapping check, O(n^2) but branch-light).
+int32_t extent_any_overlap(const int64_t* uls, const int64_t* lrs,
+                           int64_t n, int64_t nd) {
+  for (int64_t i = 0; i < n; ++i) {
+    for (int64_t j = i + 1; j < n; ++j) {
+      const int64_t* ul_i = uls + i * nd;
+      const int64_t* lr_i = lrs + i * nd;
+      const int64_t* ul_j = uls + j * nd;
+      const int64_t* lr_j = lrs + j * nd;
+      int overlap = 1;
+      for (int64_t d = 0; d < nd; ++d) {
+        int64_t a = ul_i[d] > ul_j[d] ? ul_i[d] : ul_j[d];
+        int64_t b = lr_i[d] < lr_j[d] ? lr_i[d] : lr_j[d];
+        if (a >= b) {
+          overlap = 0;
+          break;
+        }
+      }
+      if (overlap) return 1;
+    }
+  }
+  return 0;
+}
+
+// Sum of box volumes (the is_complete coverage check pairs this with
+// extent_any_overlap).
+int64_t extent_total_volume(const int64_t* uls, const int64_t* lrs,
+                            int64_t n, int64_t nd) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t vol = 1;
+    for (int64_t d = 0; d < nd; ++d) {
+      vol *= lrs[i * nd + d] - uls[i * nd + d];
+    }
+    total += vol;
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------
+// Parallel blob IO (checkpoint shards)
+// ---------------------------------------------------------------------
+
+static int write_one(const char* path, const uint8_t* data, int64_t size) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  size_t wrote = std::fwrite(data, 1, (size_t)size, f);
+  std::fclose(f);
+  return wrote == (size_t)size ? 0 : -2;
+}
+
+static int read_one(const char* path, uint8_t* data, int64_t size) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  size_t got = std::fread(data, 1, (size_t)size, f);
+  std::fclose(f);
+  return got == (size_t)size ? 0 : -2;
+}
+
+// Write n blobs concurrently with nthreads workers. paths: array of
+// C strings; ptrs/sizes parallel arrays. Returns 0 on success, else the
+// first nonzero worker status.
+int32_t blob_write_parallel(const char** paths, const uint8_t** ptrs,
+                            const int64_t* sizes, int64_t n,
+                            int32_t nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  std::atomic<int64_t> next(0);
+  std::atomic<int32_t> status(0);
+  auto work = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) break;
+      int rc = write_one(paths[i], ptrs[i], sizes[i]);
+      if (rc != 0) {
+        int32_t expected = 0;
+        status.compare_exchange_strong(expected, rc);
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  int32_t tcount = (int32_t)(n < nthreads ? n : nthreads);
+  threads.reserve(tcount);
+  for (int32_t t = 0; t < tcount; ++t) threads.emplace_back(work);
+  for (auto& th : threads) th.join();
+  return status.load();
+}
+
+int32_t blob_read_parallel(const char** paths, uint8_t** ptrs,
+                           const int64_t* sizes, int64_t n,
+                           int32_t nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  std::atomic<int64_t> next(0);
+  std::atomic<int32_t> status(0);
+  auto work = [&]() {
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) break;
+      int rc = read_one(paths[i], ptrs[i], sizes[i]);
+      if (rc != 0) {
+        int32_t expected = 0;
+        status.compare_exchange_strong(expected, rc);
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  int32_t tcount = (int32_t)(n < nthreads ? n : nthreads);
+  threads.reserve(tcount);
+  for (int32_t t = 0; t < tcount; ++t) threads.emplace_back(work);
+  for (auto& th : threads) th.join();
+  return status.load();
+}
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+"""Native C++ component + checkpoint tests."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu import native
+from spartan_tpu.array import extent, tiling
+from spartan_tpu.array.extent import TileExtent
+from spartan_tpu.utils import checkpoint
+
+
+def test_native_builds():
+    assert native.lib() is not None, "C++ extension failed to build"
+
+
+def test_intersect_batch_matches_python():
+    rng = np.random.RandomState(0)
+    uls = rng.randint(0, 50, (200, 2)).astype(np.int64)
+    lrs = uls + rng.randint(1, 20, (200, 2))
+    q_ul, q_lr = (20, 20), (45, 45)
+    mask, out_ul, out_lr = native.intersect_batch(uls, lrs, q_ul, q_lr)
+    region = TileExtent(q_ul, q_lr, (100, 100))
+    for i in range(200):
+        e = TileExtent(uls[i], lrs[i], (100, 100))
+        ix = e.intersection(region)
+        assert mask[i] == (ix is not None)
+        if ix is not None:
+            assert tuple(out_ul[i]) == ix.ul
+            assert tuple(out_lr[i]) == ix.lr
+
+
+def test_any_overlap_and_volume():
+    grid = extent.tile_grid((12, 12), (3, 3))
+    uls = np.array([e.ul for e in grid], np.int64)
+    lrs = np.array([e.lr for e in grid], np.int64)
+    assert not native.any_overlap(uls, lrs)
+    assert native.total_volume(uls, lrs) == 144
+    # introduce an overlap
+    uls2 = np.vstack([uls, [[0, 0]]])
+    lrs2 = np.vstack([lrs, [[2, 2]]])
+    assert native.any_overlap(uls2, lrs2)
+
+
+def test_find_overlapping_native_path(mesh2d):
+    from spartan_tpu.utils.config import FLAGS
+
+    grid = extent.tile_grid((64, 64), (16, 16))  # 256 tiles > threshold
+    region = TileExtent((10, 10), (20, 20), (64, 64))
+    native_hits = extent.find_overlapping(grid, region)
+    FLAGS.use_cpp_extent = False
+    try:
+        py_hits = extent.find_overlapping(grid, region)
+    finally:
+        FLAGS.use_cpp_extent = True
+    assert native_hits == py_hits
+    assert extent.is_complete((64, 64), grid)
+
+
+def test_blob_roundtrip():
+    rng = np.random.RandomState(1)
+    arrays = [rng.rand(16, 8).astype(np.float32) for _ in range(5)]
+    with tempfile.TemporaryDirectory() as d:
+        paths = [os.path.join(d, f"b{i}.bin") for i in range(5)]
+        native.write_blobs(paths, arrays)
+        outs = [np.empty_like(a) for a in arrays]
+        native.read_blobs(paths, outs)
+        for a, b in zip(arrays, outs):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_blob_read_missing_fails():
+    with tempfile.TemporaryDirectory() as d:
+        with pytest.raises(IOError):
+            native.read_blobs([os.path.join(d, "nope.bin")],
+                              [np.empty(4, np.float32)])
+
+
+def test_checkpoint_roundtrip(mesh2d):
+    x = np.random.RandomState(2).rand(16, 8).astype(np.float32)
+    arr = st.from_numpy(x, tiling=tiling.block(2)).evaluate()
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save(d, arr)
+        back = checkpoint.load(d)
+        np.testing.assert_array_equal(back.glom(), x)
+        assert back.tiling == arr.tiling
+        # load with an explicit different tiling
+        back2 = checkpoint.load(d, tiling=tiling.row(2))
+        np.testing.assert_array_equal(back2.glom(), x)
+        assert back2.tiling == tiling.row(2)
+
+
+def test_checkpoint_replicated_writes_once(mesh2d):
+    x = np.random.RandomState(3).rand(8, 8).astype(np.float32)
+    arr = st.from_numpy(x, tiling=tiling.replicated(2))
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save(d, arr)
+        blobs = [f for f in os.listdir(d) if f.endswith(".bin")]
+        assert len(blobs) == 1  # replicated shards deduped
+        np.testing.assert_array_equal(checkpoint.load(d).glom(), x)
+
+
+def test_checkpoint_tree(mesh2d):
+    rng = np.random.RandomState(4)
+    state = {"w": st.from_numpy(rng.rand(8, 4).astype(np.float32)),
+             "b": st.from_numpy(rng.rand(4).astype(np.float32))}
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_tree(d, state)
+        back = checkpoint.load_tree(d)
+        assert set(back) == {"w", "b"}
+        np.testing.assert_array_equal(back["w"].glom(),
+                                      state["w"].glom())
+        np.testing.assert_array_equal(back["b"].glom(),
+                                      state["b"].glom())
