@@ -15,6 +15,7 @@ iterative drivers (k-means, SGD) hit the cache every step.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -33,6 +34,27 @@ from ..utils.log import log_debug
 _ids = itertools.count()
 
 
+def _user_site() -> Optional[Tuple[str, int, str]]:
+    """First stack frame outside spartan_tpu — the user line that built
+    this expr (the reference's ExprTrace error attribution, SURVEY.md §5).
+    """
+    import sys
+
+    f = sys._getframe(2)
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(pkg):
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
+class ExprError(RuntimeError):
+    """Evaluation error annotated with the user line that built the
+    failing expression."""
+
+
 class Expr:
     """A node in the lazy DAG. Subclasses define children + lowering."""
 
@@ -42,6 +64,7 @@ class Expr:
         self._dtype = np.dtype(dtype)
         self._result: Optional[DistArray] = None
         self._forced_tiling: Optional[Tiling] = None
+        self._site = _user_site()
 
     # -- structure ------------------------------------------------------
 
@@ -76,7 +99,16 @@ class Expr:
 
     def lower(self, env: Dict[int, Any]) -> Any:
         if self._id not in env:
-            val = self._lower(env)
+            try:
+                val = self._lower(env)
+            except Exception as e:
+                if self._site and not getattr(e, "_expr_annotated", False):
+                    e._expr_annotated = True  # annotate innermost only
+                    e.add_note(
+                        f"while evaluating {type(self).__name__} built at "
+                        f"{self._site[0]}:{self._site[1]} "
+                        f"(in {self._site[2]})")
+                raise
             if self._forced_tiling is not None:
                 # smart-tiling chose this node's layout: constrain it so
                 # GSPMD materializes the planned resharding points
@@ -111,6 +143,18 @@ class Expr:
         from .optimize import optimize
 
         return optimize(self)
+
+    def invalidate(self) -> None:
+        """Drop this node's cached result; the next force recomputes from
+        lineage (exprs are deterministic — SURVEY.md §5 failure
+        recovery: recompute-from-expr-DAG)."""
+        self._result = None
+
+    def recompute(self) -> DistArray:
+        """Lineage-based recovery: re-evaluate this expr from its
+        (deterministic) DAG, ignoring the cached result."""
+        self.invalidate()
+        return evaluate(self)
 
     def glom(self) -> np.ndarray:
         out = evaluate(self).glom()
@@ -313,6 +357,9 @@ class ValExpr(Expr):
         super().__init__(value.shape, value.dtype)
         self.value = value
         self._result = value
+
+    def invalidate(self) -> None:
+        pass  # a Val IS its data; there is no lineage to recompute from
 
     def children(self) -> Tuple[Expr, ...]:
         return ()
@@ -535,7 +582,12 @@ def evaluate(expr: Expr) -> DistArray:
         pass
 
     args = [_leaf_arg(l) for l in leaves]
-    out = jitted(*args)
+    if FLAGS.profile:
+        with jax.profiler.trace(FLAGS.profile_dir):
+            out = jitted(*args)
+            jax.block_until_ready(out)
+    else:
+        out = jitted(*args)
     if is_tuple:
         result: Any = tuple(DistArray(o, t, mesh)
                             for o, t in zip(out, out_tilings))

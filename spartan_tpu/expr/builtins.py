@@ -28,6 +28,7 @@ __all__ = [
     "argmin", "reduce", "ndarray", "norm", "diag", "diagonal", "tril",
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
+    "sort", "argsort", "median", "unique_counts",
 ]
 
 
@@ -235,6 +236,26 @@ def count_zero(x) -> Expr:
 
 def size(x) -> int:
     return as_expr(x).size
+
+
+def sort(x, axis: int = -1) -> Expr:
+    """Sorted copy along an axis. XLA lowers the sort (bitonic on TPU);
+    the reference's sampling-based distributed sort becomes a single
+    traced op over the sharded operand."""
+    return map_expr(lambda v: jnp.sort(v, axis=axis), as_expr(x))
+
+
+def argsort(x, axis: int = -1) -> Expr:
+    return map_expr(lambda v: jnp.argsort(v, axis=axis), as_expr(x))
+
+
+def median(x, axis=None) -> Expr:
+    return map_expr(lambda v: jnp.median(v, axis=axis), as_expr(x))
+
+
+def unique_counts(x, size: int) -> Expr:
+    """Counts of each value in [0, size) — static-shape unique()."""
+    return bincount(x, length=size)
 
 
 def scan(x, axis: int = 0, op: str = "add") -> Expr:

@@ -1,0 +1,75 @@
+"""2-D stencil / pooling ops (``[U] spartan/expr/stencil.py`` [LOW] —
+SURVEY.md §2.3: convnet stencil/maxpool in some reference versions).
+
+TPU-native: the stencil is ``lax.conv_general_dilated`` (MXU) and pooling
+is ``lax.reduce_window`` (VPU), traced into the consuming jit like any
+map — no halo-exchange bookkeeping, GSPMD partitions spatial dims with
+halo transfers when the inputs are sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..expr.base import Expr, as_expr
+from ..expr.map2 import map2
+
+Stride = Union[int, Tuple[int, int]]
+
+
+def _pair(v: Stride) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def stencil(images, filters, stride: Stride = 1,
+            padding: str = "SAME") -> Expr:
+    """images (N, H, W, C), filters (KH, KW, C, O) -> (N, H', W', O)."""
+    images = as_expr(images)
+    filters = as_expr(filters)
+    s = _pair(stride)
+
+    def kern(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=s, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    return map2([images, filters], kern)
+
+
+def maxpool(images, window: Stride = 2, stride: Stride = None,
+            padding: str = "VALID") -> Expr:
+    """images (N, H, W, C) max-pooled over spatial dims."""
+    images = as_expr(images)
+    w = _pair(window)
+    s = _pair(stride) if stride is not None else w
+
+    def kern(x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1,) + w + (1,),
+            window_strides=(1,) + s + (1,),
+            padding=padding)
+
+    return map2([images], kern)
+
+
+def avgpool(images, window: Stride = 2, stride: Stride = None,
+            padding: str = "VALID") -> Expr:
+    images = as_expr(images)
+    w = _pair(window)
+    s = _pair(stride) if stride is not None else w
+    denom = float(w[0] * w[1])
+
+    def kern(x):
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1,) + w + (1,),
+            window_strides=(1,) + s + (1,),
+            padding=padding)
+        return summed / denom
+
+    return map2([images], kern)

@@ -1,0 +1,87 @@
+"""Tracing / profiling / cost analysis.
+
+Parity with the reference's FLAGS-gated profiling (SURVEY.md §5: cProfile
+dumps, timer spans, per-expr error attribution), re-based on the TPU
+stack: ``jax.profiler`` traces (TensorBoard/Perfetto), a fetch-forced
+timing harness (``block_until_ready`` returns early on tunneled
+platforms), per-expr HLO cost from ``compiled.cost_analysis()``, and
+device memory stats.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from .config import FLAGS
+from .log import log_info
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a jax.profiler trace (view in TensorBoard/Perfetto)."""
+    trace_dir = trace_dir or FLAGS.profile_dir
+    with jax.profiler.trace(trace_dir):
+        yield
+    log_info("profiler trace written to %s", trace_dir)
+
+
+def cost_analysis(expr) -> Dict[str, float]:
+    """FLOPs / bytes-accessed estimate of an expr's compiled program
+    (the per-expr HLO cost hook of SURVEY.md §5)."""
+    from ..expr import base as expr_base
+    from ..expr.optimize import optimize
+
+    dag = optimize(expr)
+    ctx = expr_base._SigCtx()
+    ctx.of(dag)
+    leaves = ctx.leaves
+    leaf_ids = tuple(l._id for l in leaves)
+
+    def traced(*args):
+        env = dict(zip(leaf_ids, args))
+        return dag.lower(env)
+
+    lowered = jax.jit(traced).lower(
+        *[expr_base._leaf_arg(l) for l in leaves])
+    compiled = lowered.compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, list):
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis or {})
+
+
+def benchmark(fn: Callable[[], Any], iters: int = 5,
+              warmup: int = 1) -> Dict[str, float]:
+    """Timing harness. ``fn`` must force its result (e.g. ``.glom()`` or
+    a scalar fetch) — on the tunneled axon platform only a fetch
+    guarantees the device work finished."""
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    arr = np.asarray(times)
+    return {"best": float(arr.min()), "mean": float(arr.mean()),
+            "std": float(arr.std()), "iters": iters}
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return dict(stats or {})
+    except Exception:
+        return {}
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span visible in profiler traces."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
