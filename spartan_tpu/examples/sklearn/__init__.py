@@ -1,0 +1,14 @@
+"""sklearn-compatible estimator wrappers (reference:
+``[U] spartan/examples/sklearn/`` — SURVEY.md §2.4: "a small
+sklearn-compatible wrapper subpackage").
+
+Thin fit/predict classes over the example drivers; inputs may be NumPy
+arrays, DistArrays or exprs.
+"""
+
+from .cluster import KMeans
+from .linear_model import LinearRegression, LogisticRegression, Ridge, SGDSVC
+from .naive_bayes import MultinomialNB
+
+__all__ = ["KMeans", "LinearRegression", "LogisticRegression", "Ridge",
+           "SGDSVC", "MultinomialNB"]

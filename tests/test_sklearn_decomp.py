@@ -1,0 +1,98 @@
+"""sklearn wrappers + decomposition example tests."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def test_kmeans_estimator():
+    from spartan_tpu.examples.sklearn import KMeans
+
+    rng = np.random.RandomState(0)
+    pts = np.concatenate([rng.randn(64, 4) + 5,
+                          rng.randn(64, 4) - 5]).astype(np.float32)
+    km = KMeans(n_clusters=2, max_iter=5).fit(pts)
+    assert km.cluster_centers_.shape == (2, 4)
+    pred = km.predict(pts)
+    assert (pred == km.labels_).all()
+
+
+def test_linear_estimators():
+    from spartan_tpu.examples.sklearn import (LinearRegression,
+                                              LogisticRegression, Ridge)
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(256, 8).astype(np.float32)
+    w = rng.randn(8).astype(np.float32)
+    y = X @ w
+    lr = LinearRegression(max_iter=200, lr=0.1).fit(X, y)
+    np.testing.assert_allclose(lr.coef_, w, atol=1e-2)
+    np.testing.assert_allclose(lr.predict(X), y, atol=0.05)
+    r = Ridge(alpha=0.01, max_iter=200, lr=0.1).fit(X, y)
+    assert np.abs(r.coef_ - w).max() < 0.1
+    yb = (y > 0).astype(np.float32)
+    clf = LogisticRegression(max_iter=100, lr=0.5).fit(X, yb)
+    assert (clf.predict(X) == yb).mean() > 0.95
+
+
+def test_svc_and_nb_estimators():
+    from spartan_tpu.examples.sklearn import MultinomialNB, SGDSVC
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(256, 4).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 1.5], np.float32)
+    y = np.sign(X @ w).astype(np.float32)
+    svc = SGDSVC(max_iter=150).fit(X, y)
+    assert (svc.predict(X) == y).mean() > 0.95
+
+    counts = np.abs(rng.poisson(3, (128, 6))).astype(np.float32)
+    counts[:64, :3] *= 5
+    counts[64:, 3:] *= 5
+    labels = np.r_[np.zeros(64), np.ones(64)].astype(np.int32)
+    nb = MultinomialNB().fit(counts, labels)
+    assert (nb.predict(counts) == labels).mean() > 0.9
+
+
+def test_cholesky():
+    from spartan_tpu.examples.decomposition import cholesky
+
+    rng = np.random.RandomState(3)
+    m = rng.randn(16, 16).astype(np.float32)
+    a = m @ m.T + 16 * np.eye(16, dtype=np.float32)
+    l = cholesky(st.from_numpy(a)).glom()
+    np.testing.assert_allclose(l @ l.T, a, rtol=1e-4, atol=1e-3)
+    assert np.allclose(l, np.tril(l))
+
+
+def test_qr_and_tsqr():
+    from spartan_tpu.examples.decomposition import qr, tsqr
+
+    rng = np.random.RandomState(4)
+    a = rng.randn(64, 8).astype(np.float32)
+    q, r = qr(st.from_numpy(a))
+    np.testing.assert_allclose(q @ r, a, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(q.T @ q, np.eye(8), atol=1e-4)
+    q2, r2 = tsqr(st.from_numpy(a))
+    np.testing.assert_allclose(q2 @ r2, a, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(q2.T @ q2, np.eye(8), atol=1e-4)
+
+
+def test_netflix_sgd():
+    from spartan_tpu.examples.decomposition import netflix_sgd
+
+    rng = np.random.RandomState(5)
+    u_true = rng.rand(32, 4).astype(np.float32)
+    v_true = rng.rand(24, 4).astype(np.float32)
+    r = (u_true @ v_true.T).astype(np.float32)
+    mask = rng.rand(32, 24) < 0.8
+    r_obs = (r * mask).astype(np.float32)
+    u, v = netflix_sgd(st.from_numpy(r_obs), k=4, num_iter=300, lr=0.5,
+                       reg=1e-4)
+    err = np.abs((u @ v.T)[mask] - r[mask]).mean()
+    assert err < 0.1
