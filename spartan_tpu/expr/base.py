@@ -55,6 +55,38 @@ class ExprError(RuntimeError):
     failing expression."""
 
 
+def fn_key(fn: Any) -> Any:
+    """Structural identity for a kernel function: code object + captured
+    closure values + defaults. Two closures created by the same def with
+    the same captures compare equal, so iterative drivers that rebuild
+    their kernels every step (the common pattern) still hit the compile
+    cache instead of recompiling per iteration."""
+    import functools
+
+    if isinstance(fn, functools.partial):
+        return ("partial", fn_key(fn.func), fn.args,
+                tuple(sorted(fn.keywords.items())))
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn  # builtins / callables: identity is the best we have
+    cells: Tuple = ()
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        vals = []
+        for c in closure:
+            try:
+                v = c.cell_contents
+            except ValueError:
+                v = "<empty>"
+            try:
+                hash(v)
+            except TypeError:
+                v = id(v)
+            vals.append(v)
+        cells = tuple(vals)
+    return (code, cells, getattr(fn, "__defaults__", None) or ())
+
+
 class Expr:
     """A node in the lazy DAG. Subclasses define children + lowering."""
 
@@ -606,12 +638,33 @@ def evaluate(expr: Expr) -> DistArray:
     return result
 
 
-def eval_shape_of(fn: Callable, *inputs: Expr, **kw) -> jax.ShapeDtypeStruct:
-    """Exact result shape/dtype via abstract evaluation (no FLOPs)."""
+_eval_shape_cache: Dict[Tuple, Any] = {}
+
+
+def eval_shape_of(fn: Callable, *inputs: Expr, cache_key: Any = None,
+                  **kw) -> jax.ShapeDtypeStruct:
+    """Exact result shape/dtype via abstract evaluation (no FLOPs).
+
+    With ``cache_key`` (a hashable identity for ``fn``), results are
+    memoized on input shapes/dtypes — iterative drivers rebuild
+    identical DAG structures every step and abstract evaluation is the
+    dominant Python-side cost."""
+    key = None
+    if cache_key is not None:
+        key = (cache_key,
+               tuple((i.shape, str(i.dtype),
+                      i.weak_kind if isinstance(i, ScalarExpr) else None)
+                     for i in inputs))
+        hit = _eval_shape_cache.get(key)
+        if hit is not None:
+            return hit
     specs = []
     for i in inputs:
         if isinstance(i, ScalarExpr):
             specs.append(i.pyvalue)
         else:
             specs.append(jax.ShapeDtypeStruct(i.shape, i.dtype))
-    return jax.eval_shape(fn, *specs, **kw)
+    out = jax.eval_shape(fn, *specs, **kw)
+    if key is not None and len(_eval_shape_cache) < 4096:
+        _eval_shape_cache[key] = out
+    return out

@@ -206,7 +206,9 @@ class LocalCall(LocalExpr):
                        **dict(self.fn_kw))
 
     def key(self):
-        return (("call", self.fn, self.fn_kw)
+        from .base import fn_key
+
+        return (("call", fn_key(self.fn), self.fn_kw)
                 + tuple(a.key() for a in self.args))
 
     def remap(self, mapping):

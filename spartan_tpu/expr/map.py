@@ -29,7 +29,8 @@ class MapExpr(Expr):
     def __init__(self, inputs: Sequence[Expr], op: LocalExpr):
         self.inputs: Tuple[Expr, ...] = tuple(inputs)
         self.op = op
-        out = eval_shape_of(lambda *xs: op.emit(xs), *self.inputs)
+        out = eval_shape_of(lambda *xs: op.emit(xs), *self.inputs,
+                            cache_key=("map", op.key()))
         super().__init__(out.shape, out.dtype)
 
     def children(self) -> Tuple[Expr, ...]:
@@ -148,7 +149,9 @@ class MapWithLocationExpr(Expr):
         return mapped(x)
 
     def _sig(self, ctx) -> Tuple:
-        return ("maploc", self.fn, self.fn_kw,
+        from .base import fn_key
+
+        return ("maploc", fn_key(self.fn), self.fn_kw,
                 self.input.out_tiling().axes, ctx.of(self.input))
 
     def _default_tiling(self) -> Tiling:

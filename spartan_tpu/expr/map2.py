@@ -37,8 +37,11 @@ class Map2Expr(Expr):
         self.inputs = tuple(inputs)
         self.fn = fn
         self.fn_kw = fn_kw
+        from .base import fn_key
+
         out = eval_shape_of(lambda *xs: fn(*xs, **dict(fn_kw)),
-                            *self.inputs)
+                            *self.inputs,
+                            cache_key=("map2", fn_key(fn), fn_kw))
         super().__init__(out.shape, out.dtype)
         self._map2_tiling = out_tiling
 
@@ -54,7 +57,9 @@ class Map2Expr(Expr):
         return self.fn(*vals, **dict(self.fn_kw))
 
     def _sig(self, ctx) -> Tuple:
-        return (("map2", self.fn, self.fn_kw)
+        from .base import fn_key
+
+        return (("map2", fn_key(self.fn), self.fn_kw)
                 + tuple(ctx.of(c) for c in self.inputs))
 
     def _default_tiling(self) -> Tiling:
@@ -114,7 +119,9 @@ class ShardMap2Expr(Expr):
         return mapped(*vals)
 
     def _sig(self, ctx) -> Tuple:
-        return (("smap2", self.fn,
+        from .base import fn_key
+
+        return (("smap2", fn_key(self.fn),
                  tuple(t.axes for t in self.in_tilings),
                  self._out_tiling.axes)
                 + tuple(ctx.of(c) for c in self.inputs))

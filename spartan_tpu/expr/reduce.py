@@ -143,7 +143,10 @@ class GeneralReduceExpr(Expr):
         return out.astype(self.dtype) if out.dtype != self.dtype else out
 
     def _sig(self, ctx) -> Tuple:
-        return ("greduce", self.local_reduce_fn, self.accumulate_fn,
+        from .base import fn_key
+
+        return ("greduce", fn_key(self.local_reduce_fn),
+                fn_key(self.accumulate_fn) if self.accumulate_fn else None,
                 self.axis, str(self.dtype), ctx.of(self.input))
 
     def _default_tiling(self) -> Tiling:
