@@ -22,14 +22,20 @@ from .array.extent import TileExtent
 from .array.tiling import Tiling
 from .expr import *  # noqa: F401,F403
 from .expr import __all__ as _expr_all
+from .array.sparse import SparseDistArray
+from .parallel import collectives
 from .parallel import mesh as _mesh
-from .parallel.mesh import build_mesh, get_mesh, set_mesh, use_mesh
+from .parallel.mesh import (build_mesh, get_mesh, initialize_distributed,
+                            set_mesh, status, use_mesh)
+from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
 __version__ = "0.1.0"
 
-__all__ = (["DistArray", "TileExtent", "Tiling", "FLAGS", "build_mesh",
-            "get_mesh", "set_mesh", "use_mesh", "initialize", "shutdown"]
+__all__ = (["DistArray", "SparseDistArray", "TileExtent", "Tiling", "FLAGS",
+            "build_mesh", "get_mesh", "set_mesh", "use_mesh", "initialize",
+            "initialize_distributed", "shutdown", "status", "collectives",
+            "checkpoint", "profiling"]
            + list(_expr_all))
 
 

@@ -1,8 +1,9 @@
 """Public lazy-expression API (the reference's ``spartan.expr`` surface)."""
 
-from .base import (Expr, ScalarExpr, TupleExpr, ValExpr, as_expr,
-                   clear_compile_cache, compile_cache_size, evaluate, lazify,
-                   tuple_of)
+from .base import (DictExpr, Expr, ListExpr, ScalarExpr, TupleExpr, ValExpr,
+                   as_expr, clear_compile_cache, compile_cache_size, dict_of,
+                   evaluate, lazify, tuple_of)
+from .fio import from_file, load, save
 from .builtins import *  # noqa: F401,F403
 from .builtins import __all__ as _builtin_all
 from .assign import WriteExpr, assign, write_array
@@ -20,6 +21,7 @@ from .shuffle import shuffle
 from .slice import SliceExpr, make_slice
 
 __all__ = ["Expr", "ValExpr", "ScalarExpr", "TupleExpr", "tuple_of",
+           "ListExpr", "DictExpr", "dict_of", "from_file", "load", "save",
            "as_expr", "lazify", "evaluate",
            "optimize", "dag_nodes", "map", "map_with_location", "MapExpr",
            "ReduceExpr", "GeneralReduceExpr", "CreateExpr", "RandomExpr",

@@ -1,0 +1,39 @@
+"""File IO for distributed arrays (``from_file`` / ``save`` / ``load``).
+
+Parity with the reference's parallel file paths (SURVEY.md §2.3
+``write_array.py``: "also from_numpy, parallel from_file"; §5 checkpoint).
+``.npy`` files load through NumPy; checkpoint directories (per-shard
+blobs + manifest, written by :mod:`spartan_tpu.utils.checkpoint` through
+the native C++ IO pool) round-trip DistArrays with their tilings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from ..array import distarray as da
+from ..array.tiling import Tiling
+from ..utils import checkpoint
+from .base import Expr, ValExpr
+
+
+def from_file(path: str, tiling: Optional[Tiling] = None,
+              tile_hint=None) -> Expr:
+    """Load an array from a ``.npy`` file or a checkpoint directory."""
+    if os.path.isdir(path):
+        arr = checkpoint.load(path, tiling=tiling)
+        return ValExpr(arr)
+    data = np.load(path)
+    return ValExpr(da.from_numpy(data, tiling=tiling, tile_hint=tile_hint))
+
+
+def save(path: str, expr: Any) -> None:
+    """Save an expr/DistArray as a per-shard checkpoint directory."""
+    checkpoint.save(path, expr)
+
+
+def load(path: str, tiling: Optional[Tiling] = None) -> Expr:
+    return from_file(path, tiling=tiling)

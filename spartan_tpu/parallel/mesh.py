@@ -19,6 +19,7 @@ replicated, so code is mesh-size agnostic (SURVEY.md §7 hard part 6).
 from __future__ import annotations
 
 import math
+import os
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -105,3 +106,52 @@ def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
 def device_count(mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or get_mesh()
     return int(np.prod(list(mesh.shape.values())))
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Multi-host bring-up: ``jax.distributed`` plays the role the
+    reference's master played (registration/barrier over DCN —
+    SURVEY.md §2.7). No-op (returns False) when single-host: args absent
+    and no cluster environment detected."""
+    import jax
+
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(coordinator_address,
+                                       num_processes, process_id)
+            return True
+        # auto-detection (TPU pods, SLURM, ...) — raises when standalone
+        if (os.environ.get("COORDINATOR_ADDRESS")
+                or os.environ.get("SLURM_JOB_ID")):
+            jax.distributed.initialize()
+            return True
+    except Exception as e:  # pragma: no cover - env-dependent
+        from ..utils.log import log_warn
+
+        log_warn("jax.distributed initialization failed: %s", e)
+    return False
+
+
+def status() -> dict:
+    """Cluster status snapshot (the observability analogue of the
+    reference's worker-status heartbeats — SURVEY.md §5)."""
+    import jax
+
+    mesh = get_mesh()
+    devs = jax.devices()
+    mem = {}
+    try:
+        mem = dict(jax.local_devices()[0].memory_stats() or {})
+    except Exception:
+        pass
+    return {
+        "platform": devs[0].platform if devs else "none",
+        "num_devices": len(devs),
+        "num_local_devices": len(jax.local_devices()),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "memory_stats": mem,
+    }
