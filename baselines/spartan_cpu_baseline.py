@@ -118,6 +118,66 @@ def bench_kmeans(pool, n: int = 125_000, d: int = 128, k: int = 64,
             "d": d, "k": k, "target_n": target_n}
 
 
+def _worker_pagerank(args):
+    """Per-tile sparse kernel: local CSR partial SpMV (the reference's
+    sparse tiles were scipy.sparse — SURVEY.md §2.2)."""
+    import scipy.sparse as sp
+
+    csr_tile, rank = args
+    return csr_tile @ rank
+
+
+def bench_pagerank(pool, n: int = 1_000_000, deg: int = 16,
+                   iters: int = 3) -> Dict:
+    """Config 5 denominator: row-tiled CSR SpMV + teleport, rank vector
+    shipped to every worker each iteration (the per-tile fetch cost)."""
+    import scipy.sparse as sp
+
+    rng = np.random.RandomState(3)
+    rows = np.repeat(np.arange(n), deg)
+    cols = rng.randint(0, n, n * deg)
+    m = sp.csr_matrix((np.ones(n * deg, np.float32), (rows, cols)),
+                      shape=(n, n)).T.tocsr()
+    bounds = np.linspace(0, n, N_WORKERS + 1).astype(int)
+    tiles = [m[bounds[i]:bounds[i + 1]] for i in range(N_WORKERS)]
+    rank = np.full(n, 1.0 / n, np.float32)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        parts = pool.map(_worker_pagerank, [(t, rank) for t in tiles])
+        y = np.concatenate(parts)
+        rank = (0.85 * y + 0.15 / n).astype(np.float32)
+        rank += (1.0 - rank.sum()) / n
+    dt = (time.perf_counter() - t0) / iters
+    return {"sec_per_iter": dt, "n": n, "edges": n * deg}
+
+
+def _worker_logreg(args):
+    x_tile, y_tile, w = args
+    p = 1.0 / (1.0 + np.exp(-(x_tile @ w)))
+    return x_tile.T @ (p - y_tile)
+
+
+def bench_logreg(pool, n: int = 1_250_000, d: int = 32, iters: int = 2,
+                 target_n: int = 10_000_000) -> Dict:
+    """Config 4 denominator, measured at n rows and extrapolated to 10M
+    (per-row work; 1-core box)."""
+    rng = np.random.RandomState(4)
+    x = rng.rand(n, d).astype(np.float32)
+    y = (rng.rand(n) > 0.5).astype(np.float32)
+    xt = _row_tiles(x, N_WORKERS)
+    yt = _row_tiles(y, N_WORKERS)
+    w = np.zeros(d, np.float32)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        grads = pool.map(_worker_logreg,
+                         [(a, b, w) for a, b in zip(xt, yt)])
+        w = w - 0.1 * sum(grads) / n
+    dt = (time.perf_counter() - t0) / iters
+    scale = target_n / n
+    return {"sec_per_iter_measured": dt, "n_measured": n,
+            "sec_per_iter_10m_extrapolated": dt * scale, "d": d}
+
+
 def main() -> None:
     out_path = os.path.join(os.path.dirname(__file__), "cpu_baseline.json")
     with mp.Pool(N_WORKERS) as pool:
@@ -126,6 +186,8 @@ def main() -> None:
             "dot_4096": bench_dot(pool),
             "map_sum_4096": bench_map_sum(pool),
             "kmeans_1m": bench_kmeans(pool),
+            "pagerank_1m": bench_pagerank(pool),
+            "logreg_10m": bench_logreg(pool),
         }
     with open(out_path, "w") as f:
         json.dump(results, f, indent=2)

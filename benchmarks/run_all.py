@@ -1,0 +1,153 @@
+"""Run all five BASELINE.json configs through spartan_tpu and print a
+JSON report. Timings force a result fetch (the tunneled TPU platform's
+``block_until_ready`` returns early — see SURVEY.md-era note in
+bench.py).
+
+Usage: python benchmarks/run_all.py [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMALL = "--small" in sys.argv
+
+
+def _time(fn, iters=3, warmup=1):
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def config1_map_sum(st):
+    """Elementwise map + global sum on 4096x4096 (BASELINE.json:7)."""
+    n = 512 if SMALL else 4096
+    rng = np.random.RandomState(0)
+    x = st.from_numpy(rng.rand(n, n).astype(np.float32))
+    y = st.from_numpy(rng.rand(n, n).astype(np.float32))
+
+    def run():
+        return float(((x + y) * 3.0 - x).sum().glom())
+
+    t = _time(run)
+    return {"seconds": t, "gflops": 4.0 * n * n / t / 1e9, "n": n}
+
+
+def config2_dot(st):
+    """Dense dot 8192x8192 (BASELINE.json:8)."""
+    n = 512 if SMALL else 8192
+    rng = np.random.RandomState(1)
+    a = st.from_numpy(rng.rand(n, n).astype(np.float32))
+    b = st.from_numpy(rng.rand(n, n).astype(np.float32))
+
+    def run():
+        return float((st.dot(a, b) * (4.0 / n)).sum().glom())
+
+    t = _time(run)
+    return {"seconds": t, "tflops": 2.0 * n ** 3 / t / 1e12, "n": n}
+
+
+def config3_kmeans(st):
+    """k-means 1M x 128, k=64 (BASELINE.json:9)."""
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr.base import ValExpr
+
+    n = 10_000 if SMALL else 1_000_000
+    d, k = 128, 64
+    rng = np.random.RandomState(2)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = ValExpr(st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate())
+
+    state = {"c": c}
+
+    def run():
+        state["c"] = ValExpr(
+            kmeans_step(pts, state["c"], k).evaluate())
+        state["c"].glom()
+
+    t = _time(run, iters=5)
+    return {"sec_per_iter": t, "iters_per_sec": 1.0 / t, "n": n,
+            "d": d, "k": k}
+
+
+def config4_logreg(st):
+    """Logistic-regression SGD on synthetic 10M-row dense
+    (BASELINE.json:10)."""
+    from spartan_tpu.examples.regression import logistic_grad
+    from spartan_tpu.expr.base import ValExpr
+
+    n = 100_000 if SMALL else 10_000_000
+    d = 32
+    rng = np.random.RandomState(3)
+    X = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    y = st.from_numpy((rng.rand(n) > 0.5).astype(np.float32))
+    state = {"w": ValExpr(st.zeros((d,), np.float32).evaluate())}
+
+    def run():
+        g = logistic_grad(X, y, state["w"])
+        state["w"] = ValExpr((state["w"] - 0.1 * g).evaluate())
+        state["w"].glom()
+
+    t = _time(run, iters=5)
+    return {"sec_per_iter": t, "iters_per_sec": 1.0 / t, "n": n, "d": d}
+
+
+def config5_sparse(st):
+    """Sparse PageRank + SSVD (BASELINE.json:11)."""
+    from spartan_tpu.array.sparse import SparseDistArray
+    from spartan_tpu.examples.pagerank import pagerank
+    from spartan_tpu.examples.ssvd import ssvd
+
+    n = 10_000 if SMALL else 1_000_000
+    deg = 16
+    rng = np.random.RandomState(4)
+    rows = np.repeat(np.arange(n), deg)
+    cols = rng.randint(0, n, n * deg)
+    links = SparseDistArray.from_coo(rows, cols,
+                                     np.ones(n * deg, np.float32), (n, n))
+    pagerank(links, num_iter=2)  # compile
+    t0 = time.perf_counter()
+    pagerank(links, num_iter=10)
+    pr_iter = (time.perf_counter() - t0) / 10
+
+    m_rows = 1024 if SMALL else 8192
+    a = st.from_numpy(rng.rand(m_rows, 512).astype(np.float32))
+    t0 = time.perf_counter()
+    u, s, vt = ssvd(a, rank=32)
+    ssvd_t = time.perf_counter() - t0
+    return {"pagerank_sec_per_iter": pr_iter, "pagerank_edges": n * deg,
+            "ssvd_seconds": ssvd_t, "ssvd_shape": [m_rows, 512]}
+
+
+def main():
+    import jax
+
+    import spartan_tpu as st
+
+    report = {
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "small": SMALL,
+        "config1_map_sum": config1_map_sum(st),
+        "config2_dot": config2_dot(st),
+        "config3_kmeans": config3_kmeans(st),
+        "config4_logreg": config4_logreg(st),
+        "config5_sparse": config5_sparse(st),
+    }
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
