@@ -1,11 +1,15 @@
 """Benchmark runner: prints ONE JSON line for the driver.
 
-Metric (BASELINE.json:2): GFLOPS/chip on dense 4096x4096 f32 dot through
-the spartan_tpu expr stack, on the default platform (the driver runs this
-on real TPU). A chain of dots is forced as one jitted program and a
-scalar is fetched at the end — on the tunneled axon platform
-``block_until_ready`` returns before execution completes, so only a
-result fetch gives honest timing. ``vs_baseline`` divides by the measured
+Metric (BASELINE.json:2): sustained GFLOPS/chip on dense 4096x4096 f32
+dot through the spartan_tpu expr stack, on the default platform (the
+driver runs this on real TPU). The dot chain runs as ONE on-device
+``st.loop`` (lax.fori_loop) of K matmuls with a single result fetch —
+on the tunneled axon platform both dispatch and fetch cost a ~50 ms
+round trip and ``block_until_ready`` returns before execution completes,
+so a long single-dispatch loop plus one fetch is the honest measurement:
+reported time includes that overhead in the denominator (a lower bound
+on device throughput). Each hop renormalizes by the running max so 512
+iterations stay finite in f32. ``vs_baseline`` divides by the measured
 8-process CPU Spartan-equivalent denominator
 (baselines/cpu_baseline.json, from baselines/spartan_cpu_baseline.py per
 SURVEY.md §6) — the >=10x target of BASELINE.json:5.
@@ -21,17 +25,16 @@ import time
 import numpy as np
 
 N = 4096
-CHAIN = 8
+K = 512
 REPS = 3
 
 
-def build_chain(st, ea, eb):
-    c = ea
-    for _ in range(CHAIN):
-        # rescale to keep magnitudes ~1 across the chain (uniform [0,1)
-        # matmul grows values by ~N/4 per hop)
-        c = st.dot(c, eb) * (4.0 / N)
-    return c.sum()
+def build(st, ea, eb, k):
+    def body(c):
+        c = st.dot(c, eb)
+        return c / st.absolute(c).max()  # keep magnitudes ~1 across hops
+
+    return st.loop(k, body, ea).sum()
 
 
 def main() -> None:
@@ -43,16 +46,15 @@ def main() -> None:
     ea = st.from_numpy(a)
     eb = st.from_numpy(b)
 
-    def run() -> float:
+    def run(k: int) -> float:
         t0 = time.perf_counter()
-        total = build_chain(st, ea, eb)
-        val = float(total.glom())  # forces full execution + tiny fetch
+        val = float(build(st, ea, eb, k).glom())  # one dispatch, one fetch
         assert np.isfinite(val)
         return time.perf_counter() - t0
 
-    run()  # warmup: compiles once; later runs hit the structural cache
-    best = min(run() for _ in range(REPS))
-    per_dot = best / CHAIN
+    run(2)  # warmup: compiles once; K is traced so reps hit the cache
+    best = min(run(K) for _ in range(REPS))
+    per_dot = best / K
     gflops = 2.0 * N * N * N / per_dot / 1e9
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -3,7 +3,8 @@
 Parity with the reference's sparse tiles (SURVEY.md §2.2: ``Tile``
 supports dense / scipy.sparse / masked; §2.5 ``sparse_update.pyx`` merge
 kernel; config 5 needs sparse PageRank / SSVD). TPU-first design per
-SURVEY.md §7 hard part 2: *static* nse (padded), entries sorted by row,
+SURVEY.md §7 hard part 2: *static* nse (padded), entries lexicographically
+(row, col)-sorted with duplicates summed at construction (COO semantics),
 stored as three device arrays (data, rows, cols) sharded along the entry
 axis. SpMV is ``segment_sum(data * x[cols], rows)`` — the scatter-merge
 runs through :mod:`spartan_tpu.ops.segment` (the Pallas/XLA merge
@@ -97,11 +98,23 @@ class SparseDistArray:
                  shape: Tuple[int, int],
                  pad_to: Optional[int] = None,
                  mesh=None) -> "SparseDistArray":
-        rows = np.asarray(rows, np.int32)
-        cols = np.asarray(cols, np.int32)
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
         data = np.asarray(data, np.float32)
-        order = np.argsort(rows, kind="stable")
-        rows, cols, data = rows[order], cols[order], data[order]
+        m = int(shape[1])
+        # lexicographic (row, col) sort + duplicate-entry summation (COO
+        # semantics, like scipy): makes the sorted_ids/indices_sorted and
+        # unique_indices claims handed to XLA / BCOO actually true
+        flat = rows * m + cols
+        uniq, inv = np.unique(flat, return_inverse=True)
+        if uniq.size < flat.size:
+            data = np.bincount(inv, weights=data.astype(np.float64),
+                               minlength=uniq.size).astype(np.float32)
+        else:
+            order = np.argsort(flat)
+            uniq, data = flat[order], data[order]
+        rows = (uniq // m).astype(np.int32)
+        cols = (uniq % m).astype(np.int32)
         nnz = data.size
         mesh = mesh or mesh_mod.get_mesh()
         n_dev = mesh_mod.device_count(mesh)
@@ -111,8 +124,13 @@ class SparseDistArray:
         total += -total % max(n_dev, 1)
         pad = total - nnz
         if pad:
-            rows = np.pad(rows, (0, pad), constant_values=shape[0])
-            cols = np.pad(cols, (0, pad))
+            # distinct out-of-range (row >= nrows) indices per padding
+            # entry, still sorted, so every merge drops them and the
+            # uniqueness claim holds across the padding too
+            j = np.arange(pad, dtype=np.int64)
+            rows = np.concatenate(
+                [rows, (shape[0] + j // max(m, 1)).astype(np.int32)])
+            cols = np.concatenate([cols, (j % max(m, 1)).astype(np.int32)])
             data = np.pad(data, (0, pad))
         sh = _entry_tiling(mesh).sharding(mesh)
         return SparseDistArray(

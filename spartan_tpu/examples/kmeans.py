@@ -65,10 +65,16 @@ def assign_points(points: Expr, centers: Expr) -> Expr:
 
 
 def kmeans(points, k: int, num_iter: int = 10,
-           centers: Optional[np.ndarray] = None, seed: int = 0
-           ) -> Tuple[np.ndarray, np.ndarray]:
-    """Full driver loop. Each step hits the expr compile cache after the
-    first iteration (SURVEY.md §3.4 'python-loop-over-jit')."""
+           centers: Optional[np.ndarray] = None, seed: int = 0,
+           fused: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Full driver loop.
+
+    ``fused`` (default) runs ALL iterations as one on-device
+    ``st.loop``/fori_loop program — one dispatch, one fetch, removing
+    the reference's per-iteration driver<->worker round trips
+    (SURVEY.md §3.4). ``fused=False`` keeps the
+    'python-loop-over-jit' shape; each step then hits the expr compile
+    cache after the first iteration."""
     points = as_expr(points)
     n, d = points.shape
     if centers is None:
@@ -78,11 +84,16 @@ def kmeans(points, k: int, num_iter: int = 10,
         centers_e: Expr = as_expr(first)
     else:
         centers_e = as_expr(np.asarray(centers, np.float32))
-    for _ in range(num_iter):
-        centers_e = kmeans_step(points, centers_e, k)
-        # force so the next iteration starts from a Val leaf (the
-        # collapse-cached pass keeps the DAG constant-size)
-        centers_e = ValExpr(centers_e.evaluate())
+    if fused:
+        centers_e = ValExpr(st.loop(
+            num_iter, lambda c: kmeans_step(points, c, k),
+            centers_e).evaluate())
+    else:
+        for _ in range(num_iter):
+            centers_e = kmeans_step(points, centers_e, k)
+            # force so the next iteration starts from a Val leaf (the
+            # collapse-cached pass keeps the DAG constant-size)
+            centers_e = ValExpr(centers_e.evaluate())
     final = centers_e.glom()
     assign = assign_points(points, centers_e).glom()
     return final, assign

@@ -17,7 +17,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..array.sparse import SparseDistArray
-from ..ops.segment import segment_sum
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

@@ -45,14 +45,22 @@ def logistic_grad(x: Expr, y: Expr, w: Expr) -> Expr:
 
 
 def linear_regression(x, y, num_iter: int = 10, lr: float = 1e-2,
-                      ridge: float = 0.0) -> np.ndarray:
+                      ridge: float = 0.0, fused: bool = True) -> np.ndarray:
     x, y = as_expr(x), as_expr(y)
     w: Expr = st.zeros((x.shape[1],), np.float32, tiling=_REPL1)
-    for _ in range(num_iter):
+
+    def step(w: Expr) -> Expr:
         g = linear_grad(x, y, w)
         if ridge:
             g = g + ridge * w
-        w = ValExpr((w - lr * g).evaluate())
+        return w - lr * g
+
+    if fused:
+        # whole SGD run = ONE program (st.loop -> fori_loop): no
+        # per-iteration dispatch (contrast SURVEY.md §3.4)
+        return st.loop(num_iter, step, w).glom()
+    for _ in range(num_iter):
+        w = ValExpr(step(w).evaluate())
     return w.glom()
 
 
@@ -61,13 +69,15 @@ def ridge_regression(x, y, num_iter: int = 10, lr: float = 1e-2,
     return linear_regression(x, y, num_iter, lr, ridge=alpha)
 
 
-def logistic_regression(x, y, num_iter: int = 10, lr: float = 1e-1
-                        ) -> np.ndarray:
+def logistic_regression(x, y, num_iter: int = 10, lr: float = 1e-1,
+                        fused: bool = True) -> np.ndarray:
     x, y = as_expr(x), as_expr(y)
     w: Expr = st.zeros((x.shape[1],), np.float32, tiling=_REPL1)
+    step = lambda w: w - lr * logistic_grad(x, y, w)  # noqa: E731
+    if fused:
+        return st.loop(num_iter, step, w).glom()
     for _ in range(num_iter):
-        g = logistic_grad(x, y, w)
-        w = ValExpr((w - lr * g).evaluate())
+        w = ValExpr(step(w).evaluate())
     return w.glom()
 
 

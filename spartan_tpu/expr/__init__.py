@@ -9,6 +9,7 @@ from .builtins import __all__ as _builtin_all
 from .assign import WriteExpr, assign, write_array
 from .dot import DotExpr, dot, dot_shardmap
 from .filter import GatherExpr, filter
+from .loop import LoopExpr, LoopItemExpr, loop
 from .map import MapExpr, map, map_with_location
 from .map2 import Map2Expr, ShardMap2Expr, map2, shard_map2
 from .ndarray import CreateExpr, RandomExpr
@@ -29,6 +30,7 @@ __all__ = ["Expr", "ValExpr", "ScalarExpr", "TupleExpr", "tuple_of",
            "assign", "write_array", "WriteExpr", "dot", "dot_shardmap",
            "DotExpr", "filter", "GatherExpr", "map2", "shard_map2",
            "Map2Expr", "ShardMap2Expr", "outer", "OuterExpr", "shuffle",
+           "loop", "LoopExpr", "LoopItemExpr",
            "transpose", "reshape", "ravel", "concatenate", "SliceExpr",
            "TransposeExpr", "ReshapeExpr", "ConcatExpr",
            ] + list(_builtin_all)

@@ -1,0 +1,228 @@
+"""On-device iteration: ``loop(n, body_fn, *init)`` -> ``lax.fori_loop``.
+
+The reference's iterative drivers (k-means SURVEY.md §3.4, PageRank,
+regression SGD) crossed the driver<->worker boundary every iteration —
+eval fan-out plus a glom per step set a hard per-iteration latency floor.
+This framework already collapses one iteration into one XLA program; a
+``LoopExpr`` collapses the *whole driver loop*: the body DAG is traced
+once and iterated by ``lax.fori_loop`` entirely on device, so an N-step
+k-means/SGD/PageRank run is ONE dispatch and ONE fetch regardless of N.
+
+The iteration count is a traced scalar (``ScalarExpr``), so changing
+``num_iter`` between runs does not recompile.
+
+No reference counterpart exists (this is capability the RPC design could
+not express); it is the TPU-native answer to SURVEY.md §3.4's
+"per-iteration latency floor" note.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from .base import Expr, as_expr
+
+
+class CarryExpr(Expr):
+    """Symbolic leaf bound to the loop-carried value inside the body DAG.
+
+    Never evaluated on its own: ``LoopExpr._lower`` seeds its id into the
+    body environment with the ``fori_loop`` carry."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype: Any, slot: int,
+                 tiling: Tiling):
+        super().__init__(shape, dtype)
+        self.slot = slot
+        self._tiling = tiling
+
+    def children(self) -> Tuple[Expr, ...]:
+        return ()
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> "CarryExpr":
+        return self
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        raise RuntimeError(
+            "loop carry used outside its loop body (exprs built from a "
+            "loop body's carry cannot escape the body function)")
+
+    def _sig(self, ctx) -> Tuple:
+        # de Bruijn level relative to the enclosing loop binders (frames
+        # pushed by LoopExpr._sig): nested loops with same-shaped carries
+        # must NOT collide in the structural compile cache
+        frames = getattr(ctx, "_loop_binders", ())
+        for level, frame in enumerate(reversed(frames)):
+            if self._id in frame:
+                return ("carry", level, self.slot, self._shape,
+                        str(self._dtype))
+        # escaped carry: unique per instance so no cache entry can alias
+        # it (lowering raises the escape error regardless)
+        return ("carry-escaped", self._id)
+
+    def _default_tiling(self) -> Tiling:
+        return self._tiling
+
+
+class LoopIndexExpr(CarryExpr):
+    """Symbolic leaf bound to the fori_loop induction variable."""
+
+    def __init__(self) -> None:
+        super().__init__((), np.int32, -1, tiling_mod.replicated(0))
+
+
+class LoopExpr(Expr):
+    """Iterates a body DAG ``n`` times on device. Internal node — always
+    consumed through ``LoopItemExpr`` projections (multi-carry loops
+    evaluate all carries in one program, like ``TupleExpr``)."""
+
+    def __init__(self, n_expr: Expr, init: Tuple[Expr, ...],
+                 carries: Tuple[CarryExpr, ...],
+                 body_roots: Tuple[Expr, ...],
+                 index_expr: Optional[LoopIndexExpr]):
+        if len(init) != len(body_roots):
+            raise ValueError(
+                f"loop body returned {len(body_roots)} values for "
+                f"{len(init)} carried inputs")
+        for i, (ini, b) in enumerate(zip(init, body_roots)):
+            if b.shape != ini.shape:
+                raise ValueError(
+                    f"loop carry {i} must keep its shape: init "
+                    f"{ini.shape}, body returned {b.shape}")
+        self.n_expr = n_expr
+        self.init = init
+        self.carries = carries
+        self.body_roots = body_roots
+        self.index_expr = index_expr
+        super().__init__((), body_roots[0].dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.n_expr,) + self.init + self.body_roots
+
+    def replace_children(self, new_children: Tuple[Expr, ...]) -> "LoopExpr":
+        k = len(self.init)
+        return LoopExpr(new_children[0], tuple(new_children[1:1 + k]),
+                        self.carries, tuple(new_children[1 + k:]),
+                        self.index_expr)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        from jax import lax
+
+        n = self.n_expr.lower(env)
+        # cast inits to the body's (stable) carry dtypes so the fori_loop
+        # carry is type-invariant even when init was e.g. a Python int
+        inits = tuple(
+            jnp.asarray(i.lower(env), b.dtype)
+            for i, b in zip(self.init, self.body_roots))
+
+        def body(i: Any, carry: Tuple[Any, ...]) -> Tuple[Any, ...]:
+            benv = dict(env)
+            if self.index_expr is not None:
+                benv[self.index_expr._id] = i
+            for ce, cv in zip(self.carries, carry):
+                benv[ce._id] = cv
+            return tuple(b.lower(benv) for b in self.body_roots)
+
+        return lax.fori_loop(0, n, body, inits)
+
+    def _sig(self, ctx) -> Tuple:
+        head = (("loop", ctx.of(self.n_expr))
+                + tuple(ctx.of(i) for i in self.init))
+        # bind the carries for the body traversal (see CarryExpr._sig)
+        frames = getattr(ctx, "_loop_binders", None)
+        if frames is None:
+            frames = []
+            ctx._loop_binders = frames
+        frame = {c._id: c.slot for c in self.carries}
+        if self.index_expr is not None:
+            frame[self.index_expr._id] = -1
+        frames.append(frame)
+        try:
+            body = tuple(ctx.of(b) for b in self.body_roots)
+        finally:
+            frames.pop()
+        return head + body
+
+    def _default_tiling(self) -> Tiling:
+        return tiling_mod.replicated(0)
+
+
+class LoopItemExpr(Expr):
+    """Projection of one carried value out of a ``LoopExpr``. The loop
+    lowers once (env-memoized) however many items are consumed."""
+
+    def __init__(self, loop: LoopExpr, idx: int):
+        self.loop = loop
+        self.idx = idx
+        b = loop.body_roots[idx]
+        super().__init__(b.shape, b.dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.loop,)
+
+    def replace_children(self, new_children: Tuple[Expr, ...]
+                         ) -> "LoopItemExpr":
+        return LoopItemExpr(new_children[0], self.idx)
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        return self.loop.lower(env)[self.idx]
+
+    def _sig(self, ctx) -> Tuple:
+        return ("loopitem", self.idx, ctx.of(self.loop))
+
+    def _default_tiling(self) -> Tiling:
+        return self.loop.body_roots[self.idx].out_tiling()
+
+
+def loop(n_iters: Any, body_fn: Callable, *init: Any,
+         with_index: bool = False):
+    """Iterate ``body_fn`` ``n_iters`` times entirely on device.
+
+    ``body_fn`` receives one lazy expr per carried value (prepended with
+    the iteration-index expr when ``with_index``) and returns the same
+    number of exprs with unchanged shapes. Returns one lazy expr per
+    carried value (a single expr for a single carry). Example::
+
+        w = st.loop(100, lambda w: w - 0.1 * grad(x, y, w), w0)
+
+    The whole loop is one XLA program: no per-iteration dispatch, no
+    per-iteration fetch (contrast SURVEY.md §3.4's per-iteration
+    driver<->worker round trips in the reference).
+    """
+    init_exprs = tuple(as_expr(i) for i in init)
+    if not init_exprs:
+        raise ValueError("loop needs at least one carried value")
+    index_expr = LoopIndexExpr() if with_index else None
+
+    def build(carry_specs: Tuple[Tuple[Tuple[int, ...], Any], ...]):
+        carries = tuple(
+            CarryExpr(shape, dtype, slot, ini.out_tiling())
+            for slot, ((shape, dtype), ini)
+            in enumerate(zip(carry_specs, init_exprs)))
+        args = ((index_expr,) if with_index else ()) + carries
+        out = body_fn(*args)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return carries, tuple(as_expr(o) for o in out)
+
+    specs = tuple((i.shape, i.dtype) for i in init_exprs)
+    carries, body_roots = build(specs)
+    out_specs = tuple((b.shape, b.dtype) for b in body_roots)
+    if len(out_specs) == len(specs) and out_specs != specs:
+        # dtype promotion in the body (e.g. int init, float update):
+        # rebuild with the promoted carry dtypes and require a fixpoint
+        carries, body_roots = build(out_specs)
+        specs2 = tuple((b.shape, b.dtype) for b in body_roots)
+        if specs2 != out_specs:
+            raise TypeError(
+                f"loop body dtypes do not stabilize: {specs} -> "
+                f"{out_specs} -> {specs2}")
+
+    le = LoopExpr(as_expr(n_iters), init_exprs, carries, body_roots,
+                  index_expr)
+    items = tuple(LoopItemExpr(le, i) for i in range(len(init_exprs)))
+    return items[0] if len(items) == 1 else items
