@@ -107,12 +107,8 @@ class SparseDistArray:
         # unique_indices claims handed to XLA / BCOO actually true
         flat = rows * m + cols
         uniq, inv = np.unique(flat, return_inverse=True)
-        if uniq.size < flat.size:
-            data = np.bincount(inv, weights=data.astype(np.float64),
-                               minlength=uniq.size).astype(np.float32)
-        else:
-            order = np.argsort(flat)
-            uniq, data = flat[order], data[order]
+        data = np.bincount(inv, weights=data.astype(np.float64),
+                           minlength=uniq.size).astype(np.float32)
         rows = (uniq // m).astype(np.int32)
         cols = (uniq % m).astype(np.int32)
         nnz = data.size

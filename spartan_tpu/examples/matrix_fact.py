@@ -1,0 +1,106 @@
+"""SGD matrix factorization on sparse ratings (reference:
+``[U] spartan/examples/netflix.py`` — the "netflix SGD matrix
+factorization" example of SURVEY.md §2.4).
+
+R (users x items, sparse COO) ~= U @ V^T, trained by minibatch SGD over
+the observed entries. TPU-first design: the reference runs per-tile
+Hogwild-style SGD kernels over rating blocks with factor rows shipped by
+RPC; here one epoch is a single traced computation — ``lax.fori_loop``
+over static-size entry batches, factor rows gathered with ``take`` and
+updated with scatter-add (``.at[].add``), so the whole epoch is one
+device dispatch with no host round trips. Padded entries carry
+``row == n_users`` (see :class:`SparseDistArray`) and fall out of every
+scatter via out-of-bounds drop semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..array.sparse import SparseDistArray
+
+
+@functools.partial(jax.jit, static_argnames=("batch", "lr", "reg"))
+def _sgd_epoch(u, v, rows, cols, vals, *, batch, lr, reg):
+    n_batches = rows.shape[0] // batch
+
+    def body(i, uv):
+        uu, vv = uv
+        r = jax.lax.dynamic_slice_in_dim(rows, i * batch, batch)
+        c = jax.lax.dynamic_slice_in_dim(cols, i * batch, batch)
+        x = jax.lax.dynamic_slice_in_dim(vals, i * batch, batch)
+        # mode='fill' zeroes gathers of padding entries (row >= n_users)
+        ur = uu.at[r].get(mode="fill", fill_value=0.0)
+        vr = vv.at[c].get(mode="fill", fill_value=0.0)
+        # padding entries may carry an in-range col (SparseDistArray pads
+        # row out-of-range only), so zero their gradients entirely —
+        # otherwise their reg term would shrink real factor rows
+        w = ((r < uu.shape[0]) & (c < vv.shape[0])).astype(uu.dtype)
+        err = (jnp.sum(ur * vr, axis=1) - x) * w
+        gu = err[:, None] * vr + reg * ur * w[:, None]
+        gv = err[:, None] * ur + reg * vr * w[:, None]
+        # out-of-bounds scatter targets (padding) drop under jit
+        uu = uu.at[r].add(-lr * gu)
+        vv = vv.at[c].add(-lr * gv)
+        return uu, vv
+
+    return jax.lax.fori_loop(0, n_batches, body, (u, v))
+
+
+@jax.jit
+def _rmse(u, v, rows, cols, vals, nnz):
+    ur = u.at[rows].get(mode="fill", fill_value=0.0)
+    vr = v.at[cols].get(mode="fill", fill_value=0.0)
+    pred = jnp.sum(ur * vr, axis=1)
+    valid = rows < u.shape[0]
+    se = jnp.sum(jnp.where(valid, (pred - vals) ** 2, 0.0))
+    return jnp.sqrt(se / nnz)
+
+
+def sgd_matrix_factorization(
+        ratings: SparseDistArray, k: int = 16, num_epochs: int = 10,
+        lr: float = 0.02, reg: float = 0.02, batch: int = 4096,
+        seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Factor sparse ``ratings`` into (U, V) with U @ V^T ~= R.
+
+    Returns dense (n_users, k) and (n_items, k) NumPy factors."""
+    n_users, n_items = ratings.shape
+    rng = np.random.RandomState(seed)
+    scale = 1.0 / np.sqrt(k)
+    u = jnp.asarray(rng.rand(n_users, k).astype(np.float32) * scale)
+    v = jnp.asarray(rng.rand(n_items, k).astype(np.float32) * scale)
+
+    # epoch order: one fixed shuffle of the entry stream (padding rides
+    # along; its gathers/scatters are dropped)
+    perm = rng.permutation(ratings.nse)
+    rows_h = np.asarray(jax.device_get(ratings.rows))[perm]
+    cols_h = np.asarray(jax.device_get(ratings.cols))[perm]
+    vals_h = np.asarray(jax.device_get(ratings.data))[perm]
+    batch = min(batch, max(int(rows_h.shape[0]), 1))
+    # pad the stream to a batch multiple with fully out-of-range entries
+    # so the tail is trained on rather than silently dropped
+    pad = -rows_h.shape[0] % batch
+    if pad:
+        rows_h = np.concatenate(
+            [rows_h, np.full(pad, n_users, rows_h.dtype)])
+        cols_h = np.concatenate(
+            [cols_h, np.full(pad, n_items, cols_h.dtype)])
+        vals_h = np.concatenate([vals_h, np.zeros(pad, vals_h.dtype)])
+    rows, cols, vals = (jnp.asarray(rows_h), jnp.asarray(cols_h),
+                        jnp.asarray(vals_h))
+
+    for _ in range(num_epochs):
+        u, v = _sgd_epoch(u, v, rows, cols, vals,
+                          batch=batch, lr=lr, reg=reg)
+    return np.asarray(jax.device_get(u)), np.asarray(jax.device_get(v))
+
+
+def rmse(ratings: SparseDistArray, u, v) -> float:
+    """Root-mean-square error over the observed entries."""
+    return float(_rmse(jnp.asarray(u), jnp.asarray(v), ratings.rows,
+                       ratings.cols, ratings.data, ratings.nnz))

@@ -189,8 +189,7 @@ class Expr:
         return evaluate(self)
 
     def glom(self) -> np.ndarray:
-        out = evaluate(self).glom()
-        return out
+        return self.evaluate().glom()
 
     def __array__(self, dtype=None):
         out = self.glom()

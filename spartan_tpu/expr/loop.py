@@ -152,14 +152,33 @@ class LoopExpr(Expr):
 
 
 class LoopItemExpr(Expr):
-    """Projection of one carried value out of a ``LoopExpr``. The loop
-    lowers once (env-memoized) however many items are consumed."""
+    """Projection of one carried value out of a ``LoopExpr``. Forcing any
+    item of a multi-carry loop evaluates ALL sibling items through one
+    ``TupleExpr`` program (one dispatch, one loop execution) and seeds
+    every sibling's result cache."""
 
     def __init__(self, loop: LoopExpr, idx: int):
         self.loop = loop
         self.idx = idx
         b = loop.body_roots[idx]
         super().__init__(b.shape, b.dtype)
+
+    def evaluate(self):
+        if self._result is not None:
+            return self._result
+        siblings = getattr(self.loop, "_items", None)
+        if siblings and self in siblings and len(siblings) > 1:
+            from .base import TupleExpr, evaluate as eval_root
+
+            results = eval_root(TupleExpr(siblings))
+            for item, res in zip(siblings, results):
+                item._result = res
+            return self._result
+        from .base import evaluate as eval_root
+
+        return eval_root(self)
+
+    force = evaluate
 
     def children(self) -> Tuple[Expr, ...]:
         return (self.loop,)
@@ -225,4 +244,5 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
     le = LoopExpr(as_expr(n_iters), init_exprs, carries, body_roots,
                   index_expr)
     items = tuple(LoopItemExpr(le, i) for i in range(len(init_exprs)))
+    le._items = items  # sibling set for one-program multi-carry forcing
     return items[0] if len(items) == 1 else items

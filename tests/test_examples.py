@@ -145,3 +145,27 @@ def test_ssvd():
     assert rel < 1e-3
     s_true = np.linalg.svd(a, compute_uv=False)[:6]
     np.testing.assert_allclose(s, s_true, rtol=1e-3)
+
+
+def test_sgd_matrix_factorization():
+    from spartan_tpu.array.sparse import SparseDistArray
+    from spartan_tpu.examples.matrix_fact import (rmse,
+                                                  sgd_matrix_factorization)
+
+    rng = np.random.RandomState(3)
+    u_true = rng.rand(40, 4).astype(np.float32)
+    v_true = rng.rand(30, 4).astype(np.float32)
+    r = u_true @ v_true.T
+    # observe 60% of entries
+    obs = rng.rand(40, 30) < 0.6
+    rows, cols = np.nonzero(obs)
+    ratings = SparseDistArray.from_coo(rows, cols, r[rows, cols], (40, 30))
+
+    u0 = rng.rand(40, 4).astype(np.float32)
+    v0 = rng.rand(30, 4).astype(np.float32)
+    before = rmse(ratings, u0 / 2, v0 / 2)
+    u, v = sgd_matrix_factorization(ratings, k=4, num_epochs=60,
+                                    lr=0.05, reg=1e-4, batch=256)
+    after = rmse(ratings, u, v)
+    assert after < 0.15
+    assert after < before / 3

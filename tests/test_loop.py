@@ -173,3 +173,22 @@ def test_nested_loop_carry_order():
     np.testing.assert_allclose(run(False), np.full(4, -8.0))
     # flip=True: c-d: 5-2=3, 5-3=2
     np.testing.assert_allclose(run(True), np.full(4, 2.0))
+
+
+def test_multi_carry_single_program():
+    """Consuming every carry of a multi-carry loop must compile ONE
+    executable and run the loop once (TupleExpr-style forcing)."""
+    from spartan_tpu.expr import base
+
+    base.clear_compile_cache()
+    ea = st.from_numpy(np.ones((4, 4), np.float32))
+    eb = st.from_numpy(np.full((4, 4), 2.0, np.float32))
+    fa, fb = st.loop(6, lambda a, b: (b, a + b), ea, eb)
+    ga, gb = fa.glom(), fb.glom()
+    assert base.compile_cache_size() == 1
+
+    a, b = np.ones((4, 4)), np.full((4, 4), 2.0)
+    for _ in range(6):
+        a, b = b, a + b
+    np.testing.assert_allclose(ga, a)
+    np.testing.assert_allclose(gb, b)
