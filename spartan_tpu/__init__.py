@@ -23,6 +23,7 @@ from .array.tiling import Tiling
 from .expr import *  # noqa: F401,F403
 from .expr import __all__ as _expr_all
 from .array.sparse import SparseDistArray
+from .array.masked import MaskedDistArray
 from .parallel import collectives
 from .parallel import mesh as _mesh
 from .parallel.mesh import (build_mesh, get_mesh, initialize_distributed,
@@ -32,7 +33,8 @@ from .utils.config import FLAGS
 
 __version__ = "0.1.0"
 
-__all__ = (["DistArray", "SparseDistArray", "TileExtent", "Tiling", "FLAGS",
+__all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
+            "Tiling", "FLAGS",
             "build_mesh", "get_mesh", "set_mesh", "use_mesh", "initialize",
             "initialize_distributed", "shutdown", "status", "collectives",
             "checkpoint", "profiling"]

@@ -1,0 +1,201 @@
+"""Masked distributed arrays (``numpy.ma`` analogue, lazy).
+
+Parity with the reference's masked tiles (SURVEY.md §2.2: ``Tile``
+supports dense / scipy.sparse / **masked**; ``Tile.merge`` honors a
+validity mask for partial writes). TPU-first design: a masked array is a
+*pair of lazy exprs* — data plus a boolean mask (True = invalid, the
+``numpy.ma`` convention) — sharded identically and composed through the
+ordinary expr DAG, so masked arithmetic and masked reductions fuse into
+the same single-jit programs as everything else; there is no separate
+masked kernel path. Reductions lower to ``where(mask, identity, x)``
+then the plain reduction, which XLA fuses into one pass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..expr import builtins as bi
+from ..expr.base import Expr, as_expr
+# NB: `from ..expr import reduce` would bind the re-exported *function*
+# (the package shadows its submodule); import the reducers directly
+from ..expr.reduce import max as _rmax
+from ..expr.reduce import min as _rmin
+from ..expr.reduce import prod as _rprod
+from ..expr.reduce import sum as _rsum
+
+
+def _mask_of(x: Any) -> Optional[Expr]:
+    return x.mask if isinstance(x, MaskedDistArray) else None
+
+
+def _data_of(x: Any) -> Any:
+    return x.data if isinstance(x, MaskedDistArray) else x
+
+
+def _union(a: Optional[Expr], b: Optional[Expr]) -> Optional[Expr]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+class MaskedDistArray:
+    """Lazy (data, mask) pair; ``mask[i] == True`` means element i is
+    invalid/missing. Arithmetic propagates masks by union; reductions
+    skip masked elements. ``glom()`` returns a ``numpy.ma`` array."""
+
+    def __init__(self, data: Any, mask: Any):
+        self.data = as_expr(data)
+        self.mask = as_expr(mask)
+        if self.mask.shape != self.data.shape:
+            raise ValueError(
+                f"mask shape {self.mask.shape} != data shape "
+                f"{self.data.shape}")
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def from_numpy(arr: Any) -> "MaskedDistArray":
+        """From a ``numpy.ma`` masked array (or a plain array: no mask)."""
+        from ..expr.builtins import from_numpy
+
+        if isinstance(arr, np.ma.MaskedArray):
+            data = np.ma.getdata(arr)
+            mask = np.ma.getmaskarray(arr)
+        else:
+            data = np.asarray(arr)
+            mask = np.zeros(data.shape, bool)
+        return MaskedDistArray(from_numpy(np.ascontiguousarray(data)),
+                               from_numpy(np.ascontiguousarray(mask)))
+
+    @staticmethod
+    def masked_invalid(x: Any) -> "MaskedDistArray":
+        """Mask NaN/Inf elements (``numpy.ma.masked_invalid``)."""
+        x = as_expr(x)
+        return MaskedDistArray(x, ~bi.isfinite(x))
+
+    @staticmethod
+    def masked_where(cond: Any, x: Any) -> "MaskedDistArray":
+        """Mask where ``cond`` is True (``numpy.ma.masked_where``)."""
+        return MaskedDistArray(as_expr(x), as_expr(cond))
+
+    # -- properties -----------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def __repr__(self) -> str:
+        return f"MaskedDistArray(shape={self.shape}, dtype={self.dtype})"
+
+    # -- arithmetic (mask union, numpy.ma semantics) --------------------
+
+    def _binop(self, other: Any, op) -> "MaskedDistArray":
+        mask = _union(self.mask, _mask_of(other))
+        return MaskedDistArray(op(self.data, _data_of(other)), mask)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b)
+
+    def __neg__(self):
+        return MaskedDistArray(-self.data, self.mask)
+
+    def __abs__(self):
+        return MaskedDistArray(bi.absolute(self.data), self.mask)
+
+    # -- mask queries ---------------------------------------------------
+
+    def count(self, axis=None) -> Expr:
+        """Number of unmasked elements (``numpy.ma`` ``count``)."""
+        valid = bi.where(self.mask, 0, 1)
+        return _rsum(valid, axis=axis)
+
+    def filled(self, fill_value: Any = 0) -> Expr:
+        """Data with masked elements replaced by ``fill_value``."""
+        return bi.where(self.mask, fill_value, self.data)
+
+    # -- reductions (skip masked elements) ------------------------------
+
+    def sum(self, axis=None) -> Expr:
+        return _rsum(self.filled(0), axis=axis)
+
+    def prod(self, axis=None) -> Expr:
+        return _rprod(self.filled(1), axis=axis)
+
+    def mean(self, axis=None) -> Expr:
+        return self.sum(axis) / self.count(axis)
+
+    def var(self, axis=None) -> Expr:
+        m = self.mean(axis)
+        if axis is not None:
+            raise NotImplementedError(
+                "masked var: only full reduction (axis=None) supported")
+        d = self.filled(np.nan) - m
+        sq = bi.where(self.mask, 0.0, d * d)
+        return _rsum(sq, axis=None) / self.count(None)
+
+    def std(self, axis=None) -> Expr:
+        return bi.sqrt(self.var(axis))
+
+    def max(self, axis=None) -> "MaskedDistArray":
+        """Masked max; fully-masked slices come back masked (numpy.ma
+        semantics), not as the identity-fill sentinel."""
+        lo = _finfo_extreme(self.dtype, lo=True)
+        out = _rmax(self.filled(lo), axis=axis)
+        return MaskedDistArray(out, bi.equal(self.count(axis), 0))
+
+    def min(self, axis=None) -> "MaskedDistArray":
+        hi = _finfo_extreme(self.dtype, lo=False)
+        out = _rmin(self.filled(hi), axis=axis)
+        return MaskedDistArray(out, bi.equal(self.count(axis), 0))
+
+    # -- materialization ------------------------------------------------
+
+    def glom(self) -> np.ma.MaskedArray:
+        return np.ma.masked_array(np.asarray(self.data.glom()),
+                                  np.asarray(self.mask.glom(), bool))
+
+    def evaluate(self) -> "MaskedDistArray":
+        from ..expr.base import ValExpr, tuple_of
+
+        d, m = tuple_of(self.data, self.mask).evaluate()
+        return MaskedDistArray(ValExpr(d), ValExpr(m))
+
+
+def _finfo_extreme(dtype, lo: bool):
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.floating):
+        info = np.finfo(dt)
+    else:
+        info = np.iinfo(dt)
+    return dt.type(info.min if lo else info.max)

@@ -281,6 +281,13 @@ class Expr:
     def __xor__(self, o):
         return self._binop(o, "bitwise_xor")
 
+    def __invert__(self):
+        from .map import build_unop
+
+        # numpy semantics: logical not for bools, bitwise not for ints
+        name = "logical_not" if np.dtype(self.dtype) == np.bool_ else "invert"
+        return build_unop(name, self)
+
     def __hash__(self) -> int:  # __eq__ is overloaded; hash by identity
         return id(self)
 

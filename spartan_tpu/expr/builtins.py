@@ -28,7 +28,8 @@ __all__ = [
     "argmin", "reduce", "ndarray", "norm", "diag", "diagonal", "tril",
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
-    "sort", "argsort", "median", "unique_counts",
+    "sort", "argsort", "median", "unique_counts", "isnan", "isinf",
+    "isfinite", "logical_not",
 ]
 
 
@@ -107,6 +108,10 @@ sin = _unary("sin")
 cos = _unary("cos")
 tan = _unary("tan")
 tanh = _unary("tanh")
+isnan = _unary("isnan")
+isinf = _unary("isinf")
+isfinite = _unary("isfinite")
+logical_not = _unary("logical_not")
 
 
 def maximum(a, b) -> Expr:

@@ -167,7 +167,9 @@ class LoopItemExpr(Expr):
         if self._result is not None:
             return self._result
         siblings = getattr(self.loop, "_items", None)
-        if siblings and self in siblings and len(siblings) > 1:
+        # identity check, NOT `in`: Expr.__eq__ builds comparison exprs
+        if (siblings and len(siblings) > 1
+                and any(s is self for s in siblings)):
             from .base import TupleExpr, evaluate as eval_root
 
             results = eval_root(TupleExpr(siblings))

@@ -1,0 +1,139 @@
+"""Masked distributed arrays vs the numpy.ma oracle (SURVEY.md §2.2:
+reference tiles support masked arrays; §4: NumPy is the universal
+oracle)."""
+
+import numpy as np
+import numpy.ma as ma
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array.masked import MaskedDistArray
+
+
+@pytest.fixture
+def pair():
+    rng = np.random.RandomState(0)
+    data = rng.rand(12, 10).astype(np.float32) + 0.5
+    mask = rng.rand(12, 10) < 0.3
+    return ma.masked_array(data, mask), MaskedDistArray.from_numpy(
+        ma.masked_array(data, mask))
+
+
+def _eq(nma, sma, rtol=1e-5):
+    got = sma.glom() if isinstance(sma, MaskedDistArray) else sma
+    if isinstance(got, ma.MaskedArray):
+        np.testing.assert_array_equal(ma.getmaskarray(got),
+                                      ma.getmaskarray(nma))
+        np.testing.assert_allclose(got.filled(0), nma.filled(0), rtol=rtol)
+    else:
+        np.testing.assert_allclose(np.asarray(got), np.asarray(nma),
+                                   rtol=rtol)
+
+
+def test_roundtrip(pair):
+    nma, sma = pair
+    _eq(nma, sma)
+
+
+def test_arithmetic_mask_union(pair):
+    nma, sma = pair
+    rng = np.random.RandomState(1)
+    d2 = rng.rand(12, 10).astype(np.float32) + 0.5
+    m2 = rng.rand(12, 10) < 0.2
+    nmb = ma.masked_array(d2, m2)
+    smb = MaskedDistArray.from_numpy(nmb)
+    _eq(nma + nmb, sma + smb)
+    _eq(nma * nmb, sma * smb)
+    _eq(nma - nmb, sma - smb)
+    _eq(nma / nmb, sma / smb)
+    _eq(nma + 2.0, sma + 2.0)
+    _eq(3.0 * nma, 3.0 * sma)
+    _eq(-nma, -sma)
+
+
+def test_reductions(pair):
+    nma, sma = pair
+    _eq(nma.sum(), float(sma.sum().glom()))
+    _eq(nma.sum(axis=0), sma.sum(axis=0).glom())
+    _eq(nma.sum(axis=1), sma.sum(axis=1).glom())
+    _eq(nma.mean(), float(sma.mean().glom()))
+    _eq(nma.mean(axis=1), sma.mean(axis=1).glom())
+    _eq(nma.max(), float(sma.max().glom()))
+    _eq(nma.min(axis=0), sma.min(axis=0).glom())
+    assert int(sma.count().glom()) == nma.count()
+    np.testing.assert_array_equal(np.asarray(sma.count(axis=1).glom()),
+                                  nma.count(axis=1))
+
+
+def test_var_std(pair):
+    nma, sma = pair
+    np.testing.assert_allclose(float(sma.var().glom()), nma.var(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(sma.std().glom()), nma.std(),
+                               rtol=1e-4)
+
+
+def test_filled(pair):
+    nma, sma = pair
+    np.testing.assert_allclose(np.asarray(sma.filled(7.0).glom()),
+                               nma.filled(7.0), rtol=1e-6)
+
+
+def test_masked_invalid():
+    data = np.array([[1.0, np.nan], [np.inf, 4.0]], np.float32)
+    sma = MaskedDistArray.masked_invalid(st.from_numpy(data))
+    nma = ma.masked_invalid(data)
+    _eq(nma, sma)
+    assert float(sma.sum().glom()) == 5.0
+
+
+def test_masked_where():
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    sma = MaskedDistArray.masked_where(st.from_numpy(data) > 6.0,
+                                       st.from_numpy(data))
+    nma = ma.masked_where(data > 6.0, data)
+    _eq(nma, sma)
+    _eq(nma.sum(), float(sma.sum().glom()))
+
+
+def test_evaluate_one_program():
+    from spartan_tpu.expr import base
+
+    data = np.arange(6, dtype=np.float32).reshape(2, 3)
+    mask = data > 3
+    sma = MaskedDistArray.from_numpy(ma.masked_array(data, mask))
+    base.clear_compile_cache()
+    out = (sma + 1.0).evaluate()
+    assert base.compile_cache_size() == 1
+    _eq(ma.masked_array(data, mask) + 1.0, out)
+
+
+def test_fully_masked_slice_max():
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    mask = np.zeros((3, 4), bool)
+    mask[1, :] = True  # row 1 fully masked
+    nma = ma.masked_array(data, mask)
+    sma = MaskedDistArray.from_numpy(nma)
+    got = sma.max(axis=1).glom()
+    want = nma.max(axis=1)
+    np.testing.assert_array_equal(ma.getmaskarray(got),
+                                  ma.getmaskarray(want))
+    np.testing.assert_allclose(got.filled(0), want.filled(0))
+    got_min = sma.min(axis=1).glom()
+    want_min = nma.min(axis=1)
+    np.testing.assert_array_equal(ma.getmaskarray(got_min),
+                                  ma.getmaskarray(want_min))
+
+
+def test_force_second_carry_first():
+    """Forcing the SECOND item of a multi-carry loop first must work
+    (identity containment, not Expr.__eq__)."""
+    ea = st.from_numpy(np.ones((4, 4), np.float32))
+    eb = st.from_numpy(np.full((4, 4), 2.0, np.float32))
+    fa, fb = st.loop(3, lambda a, b: (b, a + b), ea, eb)
+    gb = fb.glom()
+    a, b = np.ones((4, 4)), np.full((4, 4), 2.0)
+    for _ in range(3):
+        a, b = b, a + b
+    np.testing.assert_allclose(gb, b)
+    np.testing.assert_allclose(fa.glom(), a)
