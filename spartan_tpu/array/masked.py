@@ -127,6 +127,9 @@ class MaskedDistArray:
     def __pow__(self, o):
         return self._binop(o, lambda a, b: a ** b)
 
+    def __rpow__(self, o):
+        return self._binop(o, lambda a, b: b ** a)
+
     def __neg__(self):
         return MaskedDistArray(-self.data, self.mask)
 
@@ -156,11 +159,10 @@ class MaskedDistArray:
         return self.sum(axis) / self.count(axis)
 
     def var(self, axis=None) -> Expr:
-        m = self.mean(axis)
         if axis is not None:
             raise NotImplementedError(
                 "masked var: only full reduction (axis=None) supported")
-        d = self.filled(np.nan) - m
+        d = self.filled(0) - self.mean(axis)
         sq = bi.where(self.mask, 0.0, d * d)
         return _rsum(sq, axis=None) / self.count(None)
 
@@ -194,6 +196,8 @@ class MaskedDistArray:
 
 def _finfo_extreme(dtype, lo: bool):
     dt = np.dtype(dtype)
+    if dt == np.bool_:
+        return np.bool_(lo)  # identity: False for max, True for min
     if np.issubdtype(dt, np.floating):
         info = np.finfo(dt)
     else:

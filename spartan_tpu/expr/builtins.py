@@ -29,7 +29,12 @@ __all__ = [
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
     "sort", "argsort", "median", "unique_counts", "isnan", "isinf",
-    "isfinite", "logical_not",
+    "isfinite", "logical_not", "var", "std", "ptp", "cumsum", "cumprod",
+    "take", "linspace", "log1p", "expm1", "log2", "log10", "floor", "ceil",
+    "rint", "negative", "reciprocal", "add", "subtract", "multiply",
+    "divide", "true_divide", "mod", "not_equal", "greater", "greater_equal",
+    "less", "less_equal", "logical_and", "logical_or", "logical_xor",
+    "outer_product",
 ]
 
 
@@ -112,6 +117,41 @@ isnan = _unary("isnan")
 isinf = _unary("isinf")
 isfinite = _unary("isfinite")
 logical_not = _unary("logical_not")
+log1p = _unary("log1p")
+expm1 = _unary("expm1")
+log2 = _unary("log2")
+log10 = _unary("log10")
+floor = _unary("floor")
+ceil = _unary("ceil")
+rint = _unary("rint")
+negative = _unary("negative")
+reciprocal = _unary("reciprocal")
+
+
+def _binary(name):
+    def fn(a, b) -> Expr:
+        from .map import build_binop
+
+        return build_binop(name, a, b)
+
+    fn.__name__ = name
+    return fn
+
+
+add = _binary("add")
+subtract = _binary("subtract")
+multiply = _binary("multiply")
+true_divide = _binary("true_divide")
+divide = true_divide
+mod = _binary("mod")
+not_equal = _binary("not_equal")
+greater = _binary("greater")
+greater_equal = _binary("greater_equal")
+less = _binary("less")
+less_equal = _binary("less_equal")
+logical_and = _binary("logical_and")
+logical_or = _binary("logical_or")
+logical_xor = _binary("logical_xor")
 
 
 def maximum(a, b) -> Expr:
@@ -261,6 +301,65 @@ def median(x, axis=None) -> Expr:
 def unique_counts(x, size: int) -> Expr:
     """Counts of each value in [0, size) — static-shape unique()."""
     return bincount(x, length=size)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=np.float32,
+             tile_hint=None, tiling=None) -> Expr:
+    return CreateExpr((int(num),), dtype, "linspace",
+                      (float(start), float(stop), int(num), bool(endpoint)),
+                      tiling, tile_hint)
+
+
+def take(x, indices, axis=None) -> Expr:
+    """Gather elements by integer index (NumPy ``take`` semantics).
+
+    Indices enter the DAG as an input (not a closure capture) so the
+    structural compile cache keys them by shape/dtype and the gather
+    program is reused across different index arrays."""
+    idx = as_expr(np.asarray(indices))
+    return map_expr(lambda v, i: jnp.take(v, i, axis=axis), as_expr(x), idx)
+
+
+def var(x, axis=None, ddof: int = 0, keepdims: bool = False) -> Expr:
+    """Variance: two-pass (mean, then mean of squared deviations), both
+    passes fused into one XLA program by the single-jit lowering."""
+    x = as_expr(x)
+    m = mean(x, axis=axis, keepdims=True)
+    d = x - m
+    n = x.size if axis is None else _axis_count(x.shape, axis)
+    return sum(d * d, axis=axis, keepdims=keepdims) / float(n - ddof)
+
+
+def std(x, axis=None, ddof: int = 0, keepdims: bool = False) -> Expr:
+    return sqrt(var(x, axis=axis, ddof=ddof, keepdims=keepdims))
+
+
+def ptp(x, axis=None) -> Expr:
+    return max(x, axis=axis) - min(x, axis=axis)
+
+
+def _axis_count(shape, axis) -> int:
+    if isinstance(axis, (int, np.integer)):
+        axis = (int(axis),)
+    n = 1
+    for a in axis:
+        n *= shape[a % len(shape)]
+    return n
+
+
+def cumsum(x, axis: int = 0) -> Expr:
+    return scan(x, axis=axis, op="add")
+
+
+def cumprod(x, axis: int = 0) -> Expr:
+    return scan(x, axis=axis, op="mul")
+
+
+def outer_product(a, b) -> Expr:
+    """NumPy ``np.outer``: flattened outer product (distinct from the
+    tile-pair ``outer`` primitive in ``expr/outer.py``)."""
+    return map_expr(lambda u, v: u.ravel()[:, None] * v.ravel()[None, :],
+                    as_expr(a), as_expr(b))
 
 
 def scan(x, axis: int = 0, op: str = "add") -> Expr:

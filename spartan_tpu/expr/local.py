@@ -51,6 +51,8 @@ UFUNCS: Dict[str, Tuple[Callable, Callable, int]] = {
     "log": (jnp.log, np.log, 1),
     "log2": (jnp.log2, np.log2, 1),
     "log10": (jnp.log10, np.log10, 1),
+    "log1p": (jnp.log1p, np.log1p, 1),
+    "expm1": (jnp.expm1, np.expm1, 1),
     "sqrt": (jnp.sqrt, np.sqrt, 1),
     "square": (jnp.square, np.square, 1),
     "sign": (jnp.sign, np.sign, 1),

@@ -53,6 +53,10 @@ class CreateExpr(Expr):
         if k == "eye":
             n, m, k_off = self.params
             return jnp.eye(n, m, k_off, dtype=self.dtype)
+        if k == "linspace":
+            start, stop, num, endpoint = self.params
+            return jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=self.dtype)
         raise ValueError(f"unknown creation kind {self.kind!r}")
 
     def _sig(self, ctx) -> Tuple:

@@ -1,0 +1,111 @@
+"""Oracle tests for the extended NumPy-surface builtins (SURVEY.md §4:
+NumPy is the universal oracle)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def _np_pair(shape=(8, 8), seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(*shape).astype(np.float32)
+    return x, st.from_numpy(x)
+
+
+def test_var_std_ptp():
+    x, ex = _np_pair(seed=1)
+    np.testing.assert_allclose(st.var(ex).glom(), np.var(x), rtol=1e-5)
+    np.testing.assert_allclose(st.var(ex, axis=0).glom(), np.var(x, axis=0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(st.var(ex, axis=1, ddof=1).glom(),
+                               np.var(x, axis=1, ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(st.std(ex).glom(), np.std(x), rtol=1e-5)
+    np.testing.assert_allclose(st.ptp(ex, axis=0).glom(), np.ptp(x, axis=0),
+                               rtol=1e-6)
+
+
+def test_cumsum_cumprod():
+    x, ex = _np_pair(seed=2)
+    np.testing.assert_allclose(st.cumsum(ex, axis=0).glom(),
+                               np.cumsum(x, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(st.cumprod(ex, axis=1).glom(),
+                               np.cumprod(x, axis=1), rtol=1e-5)
+
+
+def test_take():
+    x, ex = _np_pair(seed=3)
+    idx = [0, 3, 5, 5, 1]
+    np.testing.assert_allclose(st.take(ex, idx, axis=0).glom(),
+                               np.take(x, idx, axis=0), rtol=1e-6)
+    np.testing.assert_allclose(st.take(ex, idx).glom(), np.take(x, idx),
+                               rtol=1e-6)
+
+
+def test_linspace():
+    np.testing.assert_allclose(st.linspace(0.0, 1.0, 16).glom(),
+                               np.linspace(0, 1, 16, dtype=np.float32),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        st.linspace(2.0, 5.0, 9, endpoint=False).glom(),
+        np.linspace(2, 5, 9, endpoint=False, dtype=np.float32), rtol=1e-6)
+
+
+def test_unary_extras():
+    x, ex = _np_pair(seed=4)
+    np.testing.assert_allclose(st.log1p(ex).glom(), np.log1p(x), rtol=1e-6)
+    np.testing.assert_allclose(st.expm1(ex).glom(), np.expm1(x), rtol=1e-6)
+    np.testing.assert_allclose(st.log2(ex + 1).glom(), np.log2(x + 1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(st.floor(ex * 10).glom(), np.floor(x * 10))
+    np.testing.assert_allclose(st.ceil(ex * 10).glom(), np.ceil(x * 10))
+    np.testing.assert_allclose(st.negative(ex).glom(), -x)
+    np.testing.assert_allclose(st.reciprocal(ex + 1).glom(),
+                               np.reciprocal(x + 1), rtol=1e-6)
+
+
+def test_binary_named_ufuncs():
+    x, ex = _np_pair(seed=5)
+    y, ey = _np_pair(seed=6)
+    np.testing.assert_allclose(st.add(ex, ey).glom(), x + y, rtol=1e-6)
+    np.testing.assert_allclose(st.subtract(ex, ey).glom(), x - y, rtol=1e-6)
+    np.testing.assert_allclose(st.multiply(ex, ey).glom(), x * y, rtol=1e-6)
+    np.testing.assert_allclose(st.divide(ex, ey + 1).glom(), x / (y + 1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(st.mod(ex * 10, ey + 1).glom(),
+                               np.mod((x * 10).astype(np.float32), y + 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_comparisons_and_logical():
+    x, ex = _np_pair(seed=7)
+    y, ey = _np_pair(seed=8)
+    assert np.array_equal(st.greater(ex, ey).glom(), x > y)
+    assert np.array_equal(st.less_equal(ex, ey).glom(), x <= y)
+    assert np.array_equal(st.not_equal(ex, ey).glom(), x != y)
+    a, b = x > 0.5, y > 0.5
+    ea, eb = st.greater(ex, 0.5), st.greater(ey, 0.5)
+    assert np.array_equal(st.logical_and(ea, eb).glom(), a & b)
+    assert np.array_equal(st.logical_or(ea, eb).glom(), a | b)
+    assert np.array_equal(st.logical_xor(ea, eb).glom(), a ^ b)
+
+
+def test_outer_product():
+    rng = np.random.RandomState(9)
+    u = rng.rand(12).astype(np.float32)
+    v = rng.rand(7).astype(np.float32)
+    out = st.outer_product(st.from_numpy(u), st.from_numpy(v)).glom()
+    np.testing.assert_allclose(out, np.outer(u, v), rtol=1e-6)
+
+
+def test_stencil_top_level():
+    rng = np.random.RandomState(10)
+    img = rng.rand(2, 8, 8, 1).astype(np.float32)
+    out = st.maxpool(st.from_numpy(img), window=2, stride=2).glom()
+    expect = img.reshape(2, 4, 2, 4, 2, 1).max(axis=(2, 4))
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
