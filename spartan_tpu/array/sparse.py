@@ -67,6 +67,21 @@ def _rsums_kernel(data, rows, *, n):
     return segment_sum(data, rows, n, sorted_ids=True)
 
 
+def _spmv_windowed_kernel(mat: "SparseDistArray"):
+    """Per-matrix jitted windowed spmv; lives on the instance so its
+    device buffers are freed with the matrix."""
+    fn = getattr(mat, "_windowed_fn", None)
+    if fn is None:
+        plan, pdata, pcols = mat._plan, mat._pdata, mat._pcols
+
+        @jax.jit
+        def fn(x):
+            return plan.segment_sum(pdata * x[pcols])
+
+        mat._windowed_fn = fn
+    return fn
+
+
 @jax.jit
 def _scale_rows_kernel(data, rows, ext_scale):
     return data * ext_scale[rows]
@@ -90,6 +105,12 @@ class SparseDistArray:
         self.shape = tuple(int(s) for s in shape)
         self.nnz = int(nnz)  # true (unpadded) count
         self.mesh = mesh or mesh_mod.get_mesh()
+        # windowed-kernel layout (ops/segment.SegmentPlan), built lazily:
+        # plan + plan-ordered data/cols device arrays + jitted kernels
+        self._plan = None
+        self._pdata = None
+        self._pcols = None
+        self._windowed_fn = None
 
     # -- construction ---------------------------------------------------
 
@@ -183,12 +204,55 @@ class SparseDistArray:
 
     # -- ops ------------------------------------------------------------
 
+    # segment-plan scratch must fit VMEM: ~4 bytes/row, <=2M rows
+    _PLAN_MAX_ROWS = 2 * 1024 * 1024
+
+    def _ensure_plan(self):
+        """Build (once) the windowed-kernel layout: a SegmentPlan over
+        the sorted row ids plus plan-ordered data/cols device arrays."""
+        if self._plan is not None:
+            return self._plan
+        from ..ops.segment import SegmentPlan
+
+        rows = np.asarray(jax.device_get(self.rows))
+        data = np.asarray(jax.device_get(self.data))
+        cols = np.asarray(jax.device_get(self.cols))
+        plan = SegmentPlan(rows, self.shape[0])
+        self._pdata = jnp.asarray(plan.reorder(data))
+        self._pcols = jnp.asarray(plan.reorder(cols, fill=0)
+                                  .astype(np.int32))
+        self._plan = plan
+        return plan
+
+    def _can_window(self) -> bool:
+        from ..ops.segment import _pallas_available
+
+        return (self.shape[0] <= self._PLAN_MAX_ROWS
+                and _pallas_available())
+
+    def spmv_traced(self, x: jax.Array) -> jax.Array:
+        """Windowed-kernel matvec, traceable inside any jit (including
+        ``lax.fori_loop`` bodies, where XLA's own scatter lowering
+        collapses — measured 2.7 s/iter vs ~170 ms for this path at 16M
+        entries on v5e). Requires a plan (see :meth:`_ensure_plan`)."""
+        plan = self._ensure_plan()
+        contrib = self._pdata * x[self._pcols]
+        return plan.segment_sum(contrib)
+
     def spmv(self, x: Any, impl: Optional[str] = None) -> jax.Array:
-        """y = A @ x for dense x (n,) or (n, d). Default path: BCOO
-        matvec (fastest measured); ``impl`` selects the segment-merge
-        ablations ('xla' | 'onehot' | 'pallas')."""
+        """y = A @ x for dense x (n,) or (n, d).
+
+        Default: the windowed Pallas path on TPU (vector x), else BCOO
+        matvec; ``impl`` forces a path ('windowed' | 'bcoo' | 'xla' |
+        'onehot' | 'pallas' segment-merge ablations)."""
         x = x.jax_array if isinstance(x, DistArray) else jnp.asarray(x)
-        if impl is None or impl == "bcoo":
+        if impl is None:
+            impl = ("windowed" if x.ndim == 1 and self._can_window()
+                    else "bcoo")
+        if impl == "windowed":
+            self._ensure_plan()
+            return _spmv_windowed_kernel(self)(x)
+        if impl == "bcoo":
             return _spmv_bcoo_kernel(self.data, self.rows, self.cols, x,
                                      shape=self.shape)
         return _spmv_kernel(self.data, self.rows, self.cols, x,

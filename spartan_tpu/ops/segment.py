@@ -116,6 +116,162 @@ def _segment_sum_pallas(vals: jax.Array, ids: jax.Array,
     return out[:, 0] if squeeze else out
 
 
+class SegmentPlan:
+    """Host-precomputed layout for the windowed sorted-segment kernel.
+
+    XLA's scatter lowering is the TPU sparse bottleneck (measured 199 ms
+    for a 16M->1M sorted segment-sum on v5e, and far worse inside
+    ``fori_loop``). This plan turns the scatter into dense one-hot
+    algebra: entries are grouped by aligned ``W``-wide output windows and
+    padded to 1024-entry subblocks; the kernel keeps the whole output
+    resident in a VMEM scratch and, per subblock, builds two small
+    one-hots from each id's lane (``id & 127``) and sublane (``id >> 7``)
+    halves, contracts them with one (8,128)x(128,128) MXU dot, and
+    accumulates the (8,128) window block at a dynamic scratch offset.
+    Measured 34 ms standalone (~20 ms fused) for the same 16M->1M merge —
+    ~6x over XLA — and it does not degrade inside ``lax.fori_loop``.
+
+    The plan is built once per static id structure (e.g. a sparse
+    matrix's rows); runtime value streams must be produced in plan order
+    (use :meth:`reorder` on the host-side companion arrays at build
+    time). Scratch residency bounds ``num_segments`` to ~2M on a 16 MB
+    VMEM part.
+    """
+
+    W = 1024          # output window (one (8,128) f32 block)
+    EB = 1024         # entries per subblock
+    SUB = 8           # subblocks per grid step
+
+    def __init__(self, ids: np.ndarray, num_segments: int):
+        ids = np.asarray(ids)
+        if ids.ndim != 1:
+            raise ValueError("SegmentPlan ids must be 1-D")
+        if np.any(np.diff(ids) < 0):
+            raise ValueError("SegmentPlan requires sorted ids")
+        n = int(num_segments)
+        W, EB, SUB = self.W, self.EB, self.SUB
+        self.num_segments = n
+        self.n_pad = -(-max(n, 1) // W) * W
+        n_windows = self.n_pad // W
+        valid = ids < n                     # out-of-range ids are dropped
+        e = int(valid.sum())
+        ids_v = ids[:e].astype(np.int64)    # sorted => valid is a prefix
+        wb_all = ids_v // W
+        counts = np.bincount(wb_all, minlength=n_windows)
+        padded = -(-counts // EB) * EB
+        total = int(padded.sum())
+        rows_out = self.n_pad // 128
+        self.outblk = min(1024, rows_out)
+        self.rows_pad = -(-rows_out // self.outblk) * self.outblk
+        step = SUB * EB
+        total_steps = max(-(-total // step), 1)
+        grand = total_steps * step
+        starts = np.zeros(n_windows, np.int64)
+        starts[1:] = np.cumsum(padded)[:-1]
+        src_starts = np.zeros(n_windows, np.int64)
+        src_starts[1:] = np.cumsum(counts)[:-1]
+        # position of each source entry in the padded stream (vectorized)
+        pos = starts[wb_all] + (np.arange(e) - src_starts[wb_all])
+        ids_local = np.full(grand, W, np.int32)      # sentinel: no match
+        ids_local[pos] = (ids_v - wb_all * W).astype(np.int32)
+        self.perm = pos                     # source entry -> padded slot
+        self.padded_size = grand
+        self.nsteps = total_steps
+        wb = np.zeros(grand // EB, np.int32)
+        wb[:total // EB] = np.repeat(
+            np.arange(n_windows, dtype=np.int32), padded // EB)
+        self._ids2d = jnp.asarray(ids_local.reshape(-1, 128))
+        self._wb = jnp.asarray(wb)
+
+    def reorder(self, arr: np.ndarray, fill=0) -> np.ndarray:
+        """Host-side: lay a per-entry companion array out in plan order."""
+        arr = np.asarray(arr)
+        out = np.full((self.padded_size,) + arr.shape[1:], fill, arr.dtype)
+        out[self.perm] = arr[:self.perm.size]
+        return out
+
+    def segment_sum(self, vals: jax.Array) -> jax.Array:
+        """Sum a plan-ordered f32 value stream into segments. Traceable
+        (usable inside jit / fori_loop / other kernels)."""
+        out2d = _windowed_segsum(vals, self._ids2d, self._wb,
+                                 rows_pad=self.rows_pad,
+                                 nsteps=self.nsteps,
+                                 outblk=self.outblk, sub=self.SUB)
+        return out2d.reshape(-1)[:self.num_segments]
+
+
+def _windowed_segsum(vals: jax.Array, ids2d: jax.Array, wb: jax.Array,
+                     *, rows_pad: int, nsteps: int, outblk: int,
+                     sub: int) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nout = rows_pad // outblk
+    vals2d = vals.astype(jnp.float32).reshape(-1, 128)
+    # flush runs on dedicated trailing grid steps AFTER all accumulation
+    # steps: every output block is flushed (including a trailing partial
+    # one — rows_pad is padded to outblk), and no entry can arrive after
+    # its block was written out, regardless of id skew
+    grid = nsteps + nout
+
+    def kernel(wb_ref, ids_ref, vals_ref, out_ref, scratch):
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _init():
+            scratch[:] = jnp.zeros_like(scratch)
+
+        @pl.when(b < nsteps)
+        def _accumulate():
+            lane_iota = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+            sub_iota = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+            for j in range(sub):
+                acc = jnp.zeros((8, 128), jnp.float32)
+                for s in range(8):
+                    ids_s = ids_ref[j * 8 + s, :]
+                    lo = ids_s & 127
+                    hi = ids_s >> 7
+                    # entries live on lanes in both one-hots: no relayouts
+                    a = (jnp.broadcast_to(lo[None, :], (128, 128))
+                         == lane_iota).astype(jnp.float32)   # (lane, entry)
+                    bmat = (jnp.broadcast_to(hi[None, :], (8, 128))
+                            == sub_iota).astype(jnp.float32)  # (subrow, e)
+                    bmat = bmat * vals_ref[j * 8 + s, :][None, :]
+                    acc = acc + jax.lax.dot_general(
+                        bmat, a, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)
+                w = wb_ref[b * sub + j]
+                scratch[pl.ds(w * 8, 8), :] += acc
+
+        @pl.when(b >= nsteps)
+        def _flush():
+            k = jnp.maximum(b - nsteps, 0)
+            out_ref[:] = scratch[pl.ds(k * outblk, outblk), :]
+
+    def in_map(b, wb_ref):
+        return (jnp.minimum(b, nsteps - 1), 0)
+
+    f = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((sub * 8, 128), in_map),
+                pl.BlockSpec((sub * 8, 128), in_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (outblk, 128),
+                lambda b, wb_ref: (jnp.maximum(b - nsteps, 0), 0)),
+            scratch_shapes=[pltpu.VMEM((rows_pad, 128), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, 128), jnp.float32),
+        interpret=not _pallas_available(),
+    )
+    return f(wb, ids2d, vals2d)
+
+
 def segment_sum(vals: jax.Array, ids: jax.Array, num_segments: int,
                 impl: Optional[str] = None,
                 sorted_ids: bool = False) -> jax.Array:
