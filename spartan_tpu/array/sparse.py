@@ -67,19 +67,19 @@ def _rsums_kernel(data, rows, *, n):
     return segment_sum(data, rows, n, sorted_ids=True)
 
 
-def _spmv_windowed_kernel(mat: "SparseDistArray"):
-    """Per-matrix jitted windowed spmv; lives on the instance so its
-    device buffers are freed with the matrix."""
-    fn = getattr(mat, "_windowed_fn", None)
-    if fn is None:
-        plan, pdata, pcols = mat._plan, mat._pdata, mat._pcols
+@functools.partial(jax.jit, static_argnames=(
+    "num_segments", "rows_pad", "nsteps", "outblk", "sub"))
+def _windowed_spmv_jit(pdata, pcols, ids2d, wb, x, *, num_segments,
+                       rows_pad, nsteps, outblk, sub):
+    """Module-level jitted windowed spmv: plan buffers enter as traced
+    arguments, so same-dimension matrices share one Mosaic compile
+    (these compiles run minutes) and nothing pins device memory."""
+    from ..ops.segment import _windowed_segsum
 
-        @jax.jit
-        def fn(x):
-            return plan.segment_sum(pdata * x[pcols])
-
-        mat._windowed_fn = fn
-    return fn
+    out2d = _windowed_segsum(pdata * x[pcols], ids2d, wb,
+                             rows_pad=rows_pad, nsteps=nsteps,
+                             outblk=outblk, sub=sub)
+    return out2d.reshape(-1)[:num_segments]
 
 
 @jax.jit
@@ -110,7 +110,6 @@ class SparseDistArray:
         self._plan = None
         self._pdata = None
         self._pcols = None
-        self._windowed_fn = None
 
     # -- construction ---------------------------------------------------
 
@@ -227,8 +226,12 @@ class SparseDistArray:
     def _can_window(self) -> bool:
         from ..ops.segment import _pallas_available
 
+        # single-device only: the plan gathers entries to host and the
+        # pallas_call is not partitionable — on a multi-chip mesh the
+        # distributed BCOO/segment paths stay the default
         return (self.shape[0] <= self._PLAN_MAX_ROWS
-                and _pallas_available())
+                and _pallas_available()
+                and mesh_mod.device_count(self.mesh) == 1)
 
     def spmv_traced(self, x: jax.Array) -> jax.Array:
         """Windowed-kernel matvec, traceable inside any jit (including
@@ -250,8 +253,15 @@ class SparseDistArray:
             impl = ("windowed" if x.ndim == 1 and self._can_window()
                     else "bcoo")
         if impl == "windowed":
-            self._ensure_plan()
-            return _spmv_windowed_kernel(self)(x)
+            if x.ndim != 1:
+                raise ValueError(
+                    "impl='windowed' supports vector x only; use the "
+                    "'bcoo' or 'xla' path for (n, d) operands")
+            plan = self._ensure_plan()
+            return _windowed_spmv_jit(
+                self._pdata, self._pcols, plan._ids2d, plan._wb, x,
+                num_segments=plan.num_segments, rows_pad=plan.rows_pad,
+                nsteps=plan.nsteps, outblk=plan.outblk, sub=plan.SUB)
         if impl == "bcoo":
             return _spmv_bcoo_kernel(self.data, self.rows, self.cols, x,
                                      shape=self.shape)

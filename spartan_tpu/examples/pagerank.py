@@ -72,22 +72,30 @@ def pagerank(links: SparseDistArray, damping: float = 0.85,
     return np.asarray(jax.device_get(rank))
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "n", "num_segments", "rows_pad", "nsteps", "outblk", "sub"))
+def _pagerank_loop(pdata, pcols, ids2d, wb, rank, damp, iters, *,
+                   n, num_segments, rows_pad, nsteps, outblk, sub):
+    """Module-level jit: plan buffers are traced arguments, so matrices
+    with the same plan dimensions share one compile (the Pallas-in-loop
+    program costs ~2 min to build) and nothing pins device memory."""
+    from ..ops.segment import _windowed_segsum
+
+    def body(_, r):
+        out2d = _windowed_segsum(pdata * r[pcols], ids2d, wb,
+                                 rows_pad=rows_pad, nsteps=nsteps,
+                                 outblk=outblk, sub=sub)
+        return _teleport_body(out2d.reshape(-1)[:num_segments], damp, n)
+
+    return jax.lax.fori_loop(0, iters, body, rank)
+
+
 def _pagerank_fused(T: SparseDistArray, rank, damp, num_iter: int):
-    """One jit: fori_loop of (windowed spmv -> teleport). The iteration
-    count is a traced loop bound so every num_iter shares one compile
-    (the Pallas-in-loop program costs ~2 min to compile). The jitted fn
-    lives on the matrix so its buffers are freed with it."""
-    n = T.shape[0]
-    T._ensure_plan()
-    fn = getattr(T, "_pagerank_fused_fn", None)
-    if fn is None:
-
-        @jax.jit
-        def fn(rank, damp, iters):
-            def body(_, r):
-                return _teleport_body(T.spmv_traced(r), damp, n)
-
-            return jax.lax.fori_loop(0, iters, body, rank)
-
-        T._pagerank_fused_fn = fn
-    return fn(rank, damp, jnp.int32(num_iter))
+    """One dispatch for the whole power iteration; the iteration count
+    is a traced loop bound so every num_iter shares one compile."""
+    plan = T._ensure_plan()
+    return _pagerank_loop(
+        T._pdata, T._pcols, plan._ids2d, plan._wb, rank, damp,
+        jnp.int32(num_iter), n=T.shape[0],
+        num_segments=plan.num_segments, rows_pad=plan.rows_pad,
+        nsteps=plan.nsteps, outblk=plan.outblk, sub=plan.SUB)
