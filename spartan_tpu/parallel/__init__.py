@@ -1,0 +1,1 @@
+from . import collectives, mesh, pipeline  # noqa: F401
