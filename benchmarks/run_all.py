@@ -132,7 +132,15 @@ def config4_logreg(st):
         state["w"].glom()
 
     t = _time(run, iters=5)
-    return {"sec_per_iter": t, "iters_per_sec": 1.0 / t, "n": n, "d": d}
+    # whole SGD run as one st.loop program (the production shape)
+    from spartan_tpu.examples.regression import logistic_regression
+
+    logistic_regression(X, y, num_iter=2)
+    t0 = time.perf_counter()
+    logistic_regression(X, y, num_iter=20)
+    t_fused = (time.perf_counter() - t0) / 20
+    return {"sec_per_iter": t, "sec_per_iter_fused": t_fused,
+            "iters_per_sec": 1.0 / t, "n": n, "d": d}
 
 
 def config5_sparse(st):
@@ -155,6 +163,7 @@ def config5_sparse(st):
 
     m_rows = 1024 if SMALL else 8192
     a = st.from_numpy(rng.rand(m_rows, 512).astype(np.float32))
+    ssvd(a, rank=32)  # compile
     t0 = time.perf_counter()
     u, s, vt = ssvd(a, rank=32)
     ssvd_t = time.perf_counter() - t0

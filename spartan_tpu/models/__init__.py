@@ -1,0 +1,43 @@
+"""Model zoo: the framework's estimator-level surface.
+
+The reference is an array framework whose "models" are its application
+algorithms (``[U] spartan/examples/`` + ``examples/sklearn/`` — SURVEY.md
+§2.4); this namespace collects them as the stable, importable model API
+so users don't reach into ``examples``:
+
+    from spartan_tpu.models import KMeans, LogisticRegression
+    from spartan_tpu.models import pagerank, ssvd, als
+
+Estimators follow the sklearn fit/predict convention; functional
+algorithms (pagerank, ssvd, als, cg, matrix factorization,
+decompositions) are re-exported directly.
+"""
+
+from ..examples.als import als  # noqa: F401
+from ..examples.conj_gradient import conjugate_gradient  # noqa: F401
+from ..examples.decomposition import (blocked_cholesky,  # noqa: F401
+                                      blocked_qr, tsqr)
+from ..examples.fuzzy_kmeans import fuzzy_kmeans  # noqa: F401
+from ..examples.kmeans import assign_points, kmeans  # noqa: F401
+from ..examples.matrix_fact import sgd_matrix_factorization  # noqa: F401
+from ..examples.naive_bayes import fit_naive_bayes  # noqa: F401
+from ..examples.pagerank import pagerank  # noqa: F401
+from ..examples.regression import (linear_regression,  # noqa: F401
+                                   logistic_regression, ridge_regression)
+from ..examples.sklearn.cluster import KMeans  # noqa: F401
+from ..examples.sklearn.linear_model import (LinearRegression,  # noqa: F401
+                                             LogisticRegression, Ridge,
+                                             SGDSVC)
+from ..examples.sklearn.naive_bayes import MultinomialNB  # noqa: F401
+from ..examples.ssvd import ssvd  # noqa: F401
+from ..examples.svm import svm_fit  # noqa: F401
+
+__all__ = [
+    "als", "conjugate_gradient", "blocked_cholesky", "blocked_qr", "tsqr",
+    "fuzzy_kmeans", "kmeans", "assign_points",
+    "sgd_matrix_factorization", "fit_naive_bayes", "pagerank",
+    "linear_regression", "logistic_regression", "ridge_regression",
+    "ssvd", "svm_fit",
+    "KMeans", "LinearRegression", "LogisticRegression", "Ridge",
+    "SGDSVC", "MultinomialNB",
+]
