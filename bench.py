@@ -2,34 +2,43 @@
 
 Metric (BASELINE.json:2): sustained GFLOPS/chip on dense 4096x4096 f32
 dot through the spartan_tpu expr stack, on the default platform (the
-driver runs this on real TPU). The dot chain runs as ONE on-device
+driver runs this on real TPU).  The dot chain runs as ONE on-device
 ``st.loop`` (lax.fori_loop) of K matmuls with a single result fetch —
 on the tunneled axon platform both dispatch and fetch cost a ~50 ms
-round trip and ``block_until_ready`` returns before execution completes,
-so a long single-dispatch loop plus one fetch is the honest measurement:
-reported time includes that overhead in the denominator (a lower bound
-on device throughput). Each hop renormalizes by the running max so 512
-iterations stay finite in f32. ``vs_baseline`` divides by the measured
-8-process CPU Spartan-equivalent denominator
+round trip, so a long single-dispatch loop plus one fetch is the honest
+measurement: reported time includes that overhead in the denominator (a
+lower bound on device throughput).  Each hop renormalizes by the running
+max so hundreds of iterations stay finite in f32.  ``vs_baseline``
+divides by the measured 8-process CPU Spartan-equivalent denominator
 (baselines/cpu_baseline.json, from baselines/spartan_cpu_baseline.py per
 SURVEY.md §6) — the >=10x target of BASELINE.json:5.
+
+Resilience (round-1 postmortem): the axon PJRT backend can block
+un-killably *inside init* (BENCH_r01.json rc=1 after a >10 min stall),
+so all device work runs in a child process the parent can SIGKILL.
+Stages run smallest-K first so a partial result exists early; the
+parent prints the best stage's single JSON line, or a diagnostic JSON
+line (never a raw traceback) if every stage dies.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 N = 4096
-K = 512
-REPS = 3
+
+# (K, reps, per-stage timeout seconds).  The small stage lands a number
+# fast even on a 1-core CPU fallback (2 runs of 4 dots); K=512 is the
+# headline measurement.  Timeboxes are generous for first-compile
+# (~20-40 s) + tunnel round trips.
+STAGES = [(2, 1, 420), (512, 3, 600)]
 
 
-def build(st, ea, eb, k):
+def _build(st, ea, eb, k):
     def body(c):
         c = st.dot(c, eb)
         return c / st.absolute(c).max()  # keep magnitudes ~1 across hops
@@ -37,43 +46,119 @@ def build(st, ea, eb, k):
     return st.loop(k, body, ea).sum()
 
 
-def main() -> None:
+def _vs_baseline(gflops: float):
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baselines", "cpu_baseline.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            cpu = json.load(f).get("dot_4096", {}).get("gflops")
+        if cpu:
+            return round(gflops / cpu, 2)
+    return None
+
+
+def worker(k: int, reps: int) -> None:
+    """Measure at loop length k and print one JSON result line."""
+    import numpy as np
+
+    import jax
+
+    platform = jax.devices()[0].platform  # first device probe: may hang
     import spartan_tpu as st
 
     rng = np.random.RandomState(0)
-    a = rng.rand(N, N).astype(np.float32)
-    b = rng.rand(N, N).astype(np.float32)
-    ea = st.from_numpy(a)
-    eb = st.from_numpy(b)
+    ea = st.from_numpy(rng.rand(N, N).astype(np.float32))
+    eb = st.from_numpy(rng.rand(N, N).astype(np.float32))
 
-    def run(k: int) -> float:
+    def run(kk: int) -> float:
         t0 = time.perf_counter()
-        val = float(build(st, ea, eb, k).glom())  # one dispatch, one fetch
+        val = float(_build(st, ea, eb, kk).glom())  # one dispatch+fetch
         assert np.isfinite(val)
         return time.perf_counter() - t0
 
-    run(2)  # warmup: compiles once; K is traced so reps hit the cache
-    best = min(run(K) for _ in range(REPS))
-    per_dot = best / K
-    gflops = 2.0 * N * N * N / per_dot / 1e9
-
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "baselines", "cpu_baseline.json")
-    vs = None
-    if os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            base = json.load(f)
-        cpu = base.get("dot_4096", {}).get("gflops")
-        if cpu:
-            vs = gflops / cpu
-
+    run(k)  # warmup at the same k: compiles once; reps hit the cache
+    best = min(run(k) for _ in range(reps))
+    gflops = 2.0 * N * N * N * k / best / 1e9
     print(json.dumps({
         "metric": "dense_dot_4096_gflops_per_chip",
         "value": round(gflops, 2),
         "unit": "GFLOPS",
-        "vs_baseline": round(vs, 2) if vs else None,
-    }))
+        "vs_baseline": _vs_baseline(gflops),
+        "platform": platform,
+        "loop_k": k,
+    }), flush=True)
+
+
+def _run_stage(k, reps, timeout):
+    """Run one worker stage with a hard timebox the child cannot defeat.
+
+    subprocess.run's TimeoutExpired path calls communicate() with no
+    timeout after kill() — if the child blocks un-killably inside PJRT
+    init (D-state) or forked helpers hold the pipes, the parent hangs
+    forever.  So: own session (killpg reaches helpers), SIGKILL on
+    timeout, bounded reap, and if the group still won't die, abandon it
+    and move on.  Returns (stdout, stderr, rc) with rc=None on timeout.
+    """
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         str(k), str(reps)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return out, err, proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            pass  # un-reapable: abandon the group, keep the bench alive
+        return "", "", None
+
+
+def main() -> None:
+    result = None
+    diags = []
+    for k, reps, timeout in STAGES:
+        t0 = time.perf_counter()
+        out, err, rc = _run_stage(k, reps, timeout)
+        if rc is None:
+            diags.append(f"K={k}: killed after {timeout}s timeout")
+            print(f"[bench] stage K={k} timed out", file=sys.stderr)
+            continue
+        dt = time.perf_counter() - t0
+        line = out.strip().splitlines()[-1] if out.strip() else ""
+        try:
+            stage = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            tail = (err or "").strip().splitlines()[-3:]
+            diags.append(f"K={k}: rc={rc} " + " | ".join(tail))
+            print(f"[bench] stage K={k} failed rc={rc}", file=sys.stderr)
+            continue
+        result = stage
+        print(f"[bench] stage K={k} ok in {dt:.1f}s: "
+              f"{stage['value']} {stage['unit']}", file=sys.stderr)
+    if result is not None:
+        print(json.dumps(result), flush=True)
+        return
+    # Every stage failed: one diagnostic JSON line, never a traceback.
+    print(json.dumps({
+        "metric": "dense_dot_4096_gflops_per_chip",
+        "value": 0.0,
+        "unit": "GFLOPS",
+        "vs_baseline": None,
+        "error": "; ".join(diags) or "no stage produced output",
+    }), flush=True)
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        main()

@@ -197,7 +197,9 @@ class MaskedDistArray:
 def _finfo_extreme(dtype, lo: bool):
     dt = np.dtype(dtype)
     if dt == np.bool_:
-        return np.bool_(lo)  # identity: False for max, True for min
+        # lo=True asks for the lowest bool (False, the max-identity);
+        # lo=False for the highest (True, the min-identity).
+        return np.bool_(not lo)
     if np.issubdtype(dt, np.floating):
         info = np.finfo(dt)
     else:

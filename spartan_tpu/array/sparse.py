@@ -110,6 +110,8 @@ class SparseDistArray:
         self._plan = None
         self._pdata = None
         self._pcols = None
+        # cached column-stochastic transition (see transition())
+        self._transition: Optional["SparseDistArray"] = None
 
     # -- construction ---------------------------------------------------
 
@@ -224,14 +226,20 @@ class SparseDistArray:
         return plan
 
     def _can_window(self) -> bool:
+        """Structural feasibility of the windowed kernel: single-device
+        only (the plan gathers entries to host and the pallas_call is
+        not partitionable — on a multi-chip mesh the distributed
+        BCOO/segment paths stay the default) and within the VMEM row
+        bound. On non-TPU backends a *forced* impl='windowed' runs the
+        kernel in Pallas interpret mode (the test path); it is only
+        chosen by default when real Pallas TPU is present."""
+        return (self.shape[0] <= self._PLAN_MAX_ROWS
+                and mesh_mod.device_count(self.mesh) == 1)
+
+    def _default_windowed(self) -> bool:
         from ..ops.segment import _pallas_available
 
-        # single-device only: the plan gathers entries to host and the
-        # pallas_call is not partitionable — on a multi-chip mesh the
-        # distributed BCOO/segment paths stay the default
-        return (self.shape[0] <= self._PLAN_MAX_ROWS
-                and _pallas_available()
-                and mesh_mod.device_count(self.mesh) == 1)
+        return self._can_window() and _pallas_available()
 
     def spmv_traced(self, x: jax.Array) -> jax.Array:
         """Windowed-kernel matvec, traceable inside any jit (including
@@ -250,13 +258,21 @@ class SparseDistArray:
         'onehot' | 'pallas' segment-merge ablations)."""
         x = x.jax_array if isinstance(x, DistArray) else jnp.asarray(x)
         if impl is None:
-            impl = ("windowed" if x.ndim == 1 and self._can_window()
+            impl = ("windowed" if x.ndim == 1 and self._default_windowed()
                     else "bcoo")
         if impl == "windowed":
             if x.ndim != 1:
                 raise ValueError(
                     "impl='windowed' supports vector x only; use the "
                     "'bcoo' or 'xla' path for (n, d) operands")
+            if not self._can_window():
+                # fail fast instead of silently gathering a sharded /
+                # oversized matrix to host for the single-device kernel
+                raise ValueError(
+                    "impl='windowed' requested but the windowed kernel "
+                    "is structurally unavailable here (needs a single-"
+                    f"device mesh and <= {self._PLAN_MAX_ROWS} rows); "
+                    "use impl='bcoo' or leave impl=None")
             plan = self._ensure_plan()
             return _windowed_spmv_jit(
                 self._pdata, self._pcols, plan._ids2d, plan._wb, x,
@@ -271,6 +287,30 @@ class SparseDistArray:
     def rsums(self) -> jax.Array:
         """Row sums (out-degree weights for PageRank)."""
         return _rsums_kernel(self.data, self.rows, n=self.shape[0])
+
+    def transition(self) -> "SparseDistArray":
+        """Column-stochastic transition matrix ``T = (A / outdegree)^T``
+        (the PageRank operator), built once and cached on this array.
+
+        The cache pins a second full-size sparse matrix (plus its
+        plan-ordered device buffers once a windowed plan is built) for
+        this object's lifetime — call :meth:`clear_cache` to release it.
+        SparseDistArray is immutable, so the cache cannot go stale."""
+        if self._transition is None:
+            out_deg = np.asarray(jax.device_get(self.rsums()))
+            inv = np.where(out_deg > 0,
+                           1.0 / np.maximum(out_deg, 1e-30), 0.0)
+            self._transition = self.scale_rows(
+                inv.astype(np.float32)).transpose()
+        return self._transition
+
+    def clear_cache(self) -> None:
+        """Drop cached derived state: the transition matrix and the
+        windowed-plan device buffers."""
+        self._transition = None
+        self._plan = None
+        self._pdata = None
+        self._pcols = None
 
     def transpose(self) -> "SparseDistArray":
         rows = np.asarray(jax.device_get(self.rows))[:self.nnz]

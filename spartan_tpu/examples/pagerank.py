@@ -35,7 +35,8 @@ def _teleport(y, damping, *, n):
 
 
 def pagerank(links: SparseDistArray, damping: float = 0.85,
-             num_iter: int = 20, tol: float = 0.0) -> np.ndarray:
+             num_iter: int = 20, tol: float = 0.0,
+             transition: Optional[SparseDistArray] = None) -> np.ndarray:
     """links[i, j] != 0 means page i links to page j. Returns ranks.
 
     On TPU (windowed spmv available, no convergence checks) the whole
@@ -43,20 +44,18 @@ def pagerank(links: SparseDistArray, damping: float = 0.85,
     of windowed-spmv + teleport steps. This is only possible because the
     windowed kernel keeps its speed inside ``fori_loop`` — XLA's own
     sparse lowerings degrade ~10x there — and it removes the per-
-    iteration dispatch round trip (~50 ms on a tunneled platform)."""
+    iteration dispatch round trip (~50 ms on a tunneled platform).
+
+    ``transition`` lets callers pass a precomputed column-stochastic
+    matrix; by default ``links.transition()`` builds it once and caches
+    it on ``links`` (host-side restructuring — the transpose re-sorts
+    all entries; see SparseDistArray.transition / clear_cache)."""
     n = links.shape[0]
-    # column-stochastic transition: T = (A / outdegree)^T — host-side
-    # restructuring (transpose re-sorts 16M entries), cached on links
-    T = getattr(links, "_pagerank_T", None)
-    if T is None:
-        out_deg = np.asarray(jax.device_get(links.rsums()))
-        inv = np.where(out_deg > 0, 1.0 / np.maximum(out_deg, 1e-30), 0.0)
-        T = links.scale_rows(inv.astype(np.float32)).transpose()
-        links._pagerank_T = T
+    T = transition if transition is not None else links.transition()
 
     rank = jnp.full((n,), 1.0 / n, jnp.float32)
     damp = jnp.float32(damping)
-    if tol == 0 and T._can_window():
+    if tol == 0 and T._default_windowed():
         return np.asarray(jax.device_get(
             _pagerank_fused(T, rank, damp, num_iter)))
     for _ in range(num_iter):

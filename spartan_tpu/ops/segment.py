@@ -153,9 +153,13 @@ class SegmentPlan:
         self.num_segments = n
         self.n_pad = -(-max(n, 1) // W) * W
         n_windows = self.n_pad // W
-        valid = ids < n                     # out-of-range ids are dropped
-        e = int(valid.sum())
-        ids_v = ids[:e].astype(np.int64)    # sorted => valid is a prefix
+        # Out-of-range ids are dropped on both ends (matching
+        # jax.ops.segment_sum): sorted => negatives are a prefix and
+        # ids >= n a suffix, so the valid run is a contiguous slice.
+        neg = int(np.searchsorted(ids, 0))
+        e = int(np.searchsorted(ids, n))
+        ids_v = ids[neg:e].astype(np.int64)
+        e -= neg
         wb_all = ids_v // W
         counts = np.bincount(wb_all, minlength=n_windows)
         padded = -(-counts // EB) * EB
@@ -174,7 +178,8 @@ class SegmentPlan:
         pos = starts[wb_all] + (np.arange(e) - src_starts[wb_all])
         ids_local = np.full(grand, W, np.int32)      # sentinel: no match
         ids_local[pos] = (ids_v - wb_all * W).astype(np.int32)
-        self.perm = pos                     # source entry -> padded slot
+        self.perm = pos                     # valid entry -> padded slot
+        self._lo = neg                      # first valid source index
         self.padded_size = grand
         self.nsteps = total_steps
         wb = np.zeros(grand // EB, np.int32)
@@ -187,7 +192,7 @@ class SegmentPlan:
         """Host-side: lay a per-entry companion array out in plan order."""
         arr = np.asarray(arr)
         out = np.full((self.padded_size,) + arr.shape[1:], fill, arr.dtype)
-        out[self.perm] = arr[:self.perm.size]
+        out[self.perm] = arr[self._lo:self._lo + self.perm.size]
         return out
 
     def segment_sum(self, vals: jax.Array) -> jax.Array:

@@ -137,3 +137,20 @@ def test_force_second_carry_first():
         a, b = b, a + b
     np.testing.assert_allclose(gb, b)
     np.testing.assert_allclose(fa.glom(), a)
+
+
+def test_bool_masked_max_min():
+    """Regression (ADVICE r1): masked bool max() must not leak a
+    masked-out True; fill identities are False for max, True for min."""
+    data = np.array([False, True, False])
+    mask = np.array([False, True, False])
+    nma = ma.masked_array(data, mask)
+    sma = MaskedDistArray.from_numpy(nma)
+    assert bool(sma.max().glom()) == bool(nma.max())  # False
+    assert bool(sma.min().glom()) == bool(nma.min())  # False
+    # and the dual: masked-out False must not leak into min()
+    nmb = ma.masked_array(np.array([True, False, True]),
+                          np.array([False, True, False]))
+    smb = MaskedDistArray.from_numpy(nmb)
+    assert bool(smb.min().glom()) == bool(nmb.min())  # True
+    assert bool(smb.max().glom()) == bool(nmb.max())  # True

@@ -168,12 +168,18 @@ def test_segment_plan_drops_out_of_range():
 def test_spmv_windowed_matches_oracle():
     import scipy.sparse as sp
 
+    from spartan_tpu.parallel import mesh as mesh_mod
+
     rng = np.random.RandomState(4)
     n = 700
     mat = sp.random(n, n, density=0.01, random_state=rng, format="coo")
-    a = SparseDistArray.from_scipy(mat)
-    x = rng.rand(n).astype(np.float32)
-    y = np.asarray(jax.device_get(a.spmv(x, impl="windowed")))
+    # the windowed kernel is single-device by design; build on a
+    # 1-device mesh so the _can_window() guard passes honestly
+    m1 = mesh_mod.build_mesh(jax.devices()[:1])
+    with mesh_mod.use_mesh(m1):
+        a = SparseDistArray.from_scipy(mat)
+        x = rng.rand(n).astype(np.float32)
+        y = np.asarray(jax.device_get(a.spmv(x, impl="windowed")))
     np.testing.assert_allclose(y, mat.tocsr() @ x, rtol=1e-4, atol=1e-6)
 
 
@@ -208,3 +214,42 @@ def test_segment_plan_skewed_ids_flush_after_accumulate():
         plan.segment_sum(jnp.asarray(plan.reorder(vals)))))
     assert out[0] == pytest.approx(e)
     assert out[1:].sum() == pytest.approx(0.0)
+
+
+def test_segment_plan_drops_negative_ids():
+    """Regression (ADVICE r1): negative ids are dropped like
+    jax.ops.segment_sum drops them, not crashed on in bincount."""
+    from spartan_tpu.ops.segment import SegmentPlan
+
+    ids = np.array([-3, -1, 0, 2, 2, 5, 9], np.int32)
+    vals = np.arange(1, 8, dtype=np.float32)
+    plan = SegmentPlan(ids, 6)  # -3, -1 and 9 out of range
+    out = np.asarray(jax.device_get(
+        plan.segment_sum(jnp.asarray(plan.reorder(vals)))))
+    keep = (ids >= 0) & (ids < 6)
+    expect = np.zeros(6, np.float32)
+    np.add.at(expect, ids[keep], vals[keep])
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_spmv_windowed_forced_unavailable_raises(mesh2d):
+    """Regression (ADVICE r1): forcing impl='windowed' on a multi-device
+    mesh must fail fast, not silently gather to host."""
+    a = SparseDistArray.from_dense(np.eye(8, dtype=np.float32))
+    with pytest.raises(ValueError, match="windowed"):
+        a.spmv(np.ones(8, np.float32), impl="windowed")
+
+
+def test_transition_cached_and_clearable():
+    """links.transition() caches; clear_cache() releases it."""
+    links = SparseDistArray.from_dense(np.array(
+        [[0, 1, 1], [1, 0, 0], [0, 0, 0]], np.float32))
+    t1 = links.transition()
+    assert links.transition() is t1
+    # column-stochastic: each column with in-links sums to the source's
+    # 1/outdegree contributions
+    dense = np.asarray(t1.glom())
+    np.testing.assert_allclose(dense.sum(axis=0), [1.0, 1.0, 0.0],
+                               rtol=1e-6)
+    links.clear_cache()
+    assert links.transition() is not t1
