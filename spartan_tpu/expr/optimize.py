@@ -115,15 +115,48 @@ class MapFusionPass(Pass):
 
 
 class ReduceFusionPass(Pass):
-    """Reduce-of-map needs no rewrite here (XLA fuses producer into the
-    reduction); the pass exists for FLAGS/ablation parity and counts
-    fusion opportunities for the optimizer report."""
+    """Fold MapExpr producers into the reduction's pre-reduce tree:
+    ``(a*b).sum()`` becomes one fused ReduceExpr node whose kernel
+    applies the elementwise tree before reducing (SURVEY.md §2.3 pass
+    (b)), shrinking the DAG exactly like MapFusion does for map chains.
+    XLA would fuse the producer anyway at the HLO level; the observable
+    effect here — and what the ablation toggles — is the DAG/trace
+    shape."""
 
     name = "reduce_fusion"
     flag = "opt_reduce_fusion"
 
     def run(self, root: Expr) -> Expr:
-        return root
+        from .reduce import ReduceExpr
+
+        def visit(n: Expr, kids: Tuple[Expr, ...]) -> Expr:
+            n = default_visit(n, kids)
+            if not isinstance(n, ReduceExpr):
+                return n
+            if not any(isinstance(c, MapExpr) and c._result is None
+                       for c in n.inputs):
+                return n
+            new_inputs: List[Expr] = []
+            pos: Dict[int, int] = {}
+
+            def input_slot(e: Expr) -> int:
+                if e._id not in pos:
+                    pos[e._id] = len(new_inputs)
+                    new_inputs.append(e)
+                return pos[e._id]
+
+            mapping: Dict[int, LocalExpr] = {}
+            for i, c in enumerate(n.inputs):
+                if isinstance(c, MapExpr) and c._result is None:
+                    sub: Dict[int, LocalExpr] = {
+                        j: LocalInput(input_slot(sc))
+                        for j, sc in enumerate(c.inputs)}
+                    mapping[i] = c.op.remap(sub)
+                else:
+                    mapping[i] = LocalInput(input_slot(c))
+            return n.with_fused(new_inputs, n.pre.remap(mapping))
+
+        return rewrite(root, visit)
 
 
 _PASSES: List[Pass] = []

@@ -12,7 +12,7 @@ semantically identical to local-reduce + merge.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -20,6 +20,7 @@ import numpy as np
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
 from .base import Expr, as_expr, eval_shape_of
+from .local import LocalExpr, LocalInput
 
 Axis = Union[None, int, Tuple[int, ...]]
 
@@ -48,22 +49,53 @@ def _norm_axis(axis: Axis, ndim: int) -> Optional[Tuple[int, ...]]:
 
 
 class ReduceExpr(Expr):
-    """Built-in reduction over axes."""
+    """Built-in reduction over axes, with an optional fused pre-reduce
+    elementwise tree.
 
-    def __init__(self, input: Expr, op: str, axis: Axis = None,
-                 keepdims: bool = False, dtype: Any = None):
+    The plain form reduces a single child.  The fused form — produced by
+    the reduce-map fusion pass (SURVEY.md §2.3 pass (b)) — holds the
+    producer MapExpr's inputs directly plus its LocalExpr tree as
+    ``pre``, so ``(a * b).sum()`` is ONE DAG node whose kernel applies
+    the elementwise tree and reduces without materializing the map
+    result (the reference folded the map into the reduction's per-tile
+    local_reduce the same way)."""
+
+    def __init__(self, input: Optional[Expr], op: str, axis: Axis = None,
+                 keepdims: bool = False, dtype: Any = None,
+                 _inputs: Optional[Tuple[Expr, ...]] = None,
+                 _pre: Optional[LocalExpr] = None):
         if op not in REDUCE_FNS:
             raise ValueError(f"unknown reduction {op!r}")
-        self.input = input
+        if _inputs is not None:
+            self.inputs: Tuple[Expr, ...] = tuple(_inputs)
+            self.pre: LocalExpr = _pre if _pre is not None else LocalInput(0)
+        else:
+            self.inputs = (input,)
+            self.pre = LocalInput(0)
         self.op = op
-        self.axis = _norm_axis(axis, input.ndim)
+        pre_out = eval_shape_of(lambda *xs: self.pre.emit(xs),
+                                *self.inputs,
+                                cache_key=("reduce_pre", self.pre.key()))
+        self._pre_shape = pre_out.shape
+        self.axis = _norm_axis(axis, len(pre_out.shape))
         self.keepdims = bool(keepdims)
         self.req_dtype = np.dtype(dtype) if dtype is not None else None
-        out = eval_shape_of(lambda x: self._emit(x), input)
+        out = eval_shape_of(lambda *xs: self._emit(xs), *self.inputs,
+                            cache_key=("reduce", self.pre.key(), op,
+                                       self.axis, self.keepdims,
+                                       str(self.req_dtype)))
         super().__init__(out.shape, out.dtype)
 
-    def _emit(self, x: Any) -> Any:
+    @property
+    def input(self) -> Expr:
+        """The sole child in the unfused form (API compatibility)."""
+        if len(self.inputs) != 1 or not isinstance(self.pre, LocalInput):
+            raise AttributeError("fused ReduceExpr has no single .input")
+        return self.inputs[0]
+
+    def _emit(self, vals: Sequence[Any]) -> Any:
         fn = REDUCE_FNS[self.op]
+        x = self.pre.emit(tuple(vals))
         ax = self.axis if self.axis is None or len(self.axis) > 1 \
             else self.axis[0]
         if self.op in _NO_KEEPDIMS:
@@ -75,21 +107,44 @@ class ReduceExpr(Expr):
         return out
 
     def children(self) -> Tuple[Expr, ...]:
-        return (self.input,)
+        return self.inputs
 
     def replace_children(self, new_children: Tuple[Expr, ...]) -> "ReduceExpr":
-        return ReduceExpr(new_children[0], self.op,
-                          self.axis, self.keepdims, self.req_dtype)
+        return ReduceExpr(None, self.op, self.axis, self.keepdims,
+                          self.req_dtype, _inputs=new_children,
+                          _pre=self.pre)
+
+    def with_fused(self, inputs: Sequence[Expr],
+                   pre: LocalExpr) -> "ReduceExpr":
+        """Rebuild with map producers spliced into the pre-reduce tree
+        (the reduce-map fusion rewrite)."""
+        return ReduceExpr(None, self.op, self.axis, self.keepdims,
+                          self.req_dtype, _inputs=tuple(inputs), _pre=pre)
 
     def _lower(self, env: Dict[int, Any]) -> Any:
-        return self._emit(self.input.lower(env))
+        return self._emit([c.lower(env) for c in self.inputs])
 
     def _sig(self, ctx) -> Tuple:
-        return ("reduce", self.op, self.axis, self.keepdims,
-                str(self.req_dtype), ctx.of(self.input))
+        return (("reduce", self.op, self.axis, self.keepdims,
+                 str(self.req_dtype), self.pre.key())
+                + tuple(ctx.of(c) for c in self.inputs))
+
+    def _pre_tiling(self) -> Tiling:
+        """Tiling of the (virtual) pre-reduce value: the largest
+        same-shaped input donates, mirroring MapExpr._default_tiling."""
+        best: Optional[Tiling] = None
+        for c in self.inputs:
+            if c.shape == self._pre_shape:
+                t = c.out_tiling()
+                if t.sharded_axes():
+                    return t
+                best = best or t
+        if best is not None:
+            return best
+        return tiling_mod.default_tiling(self._pre_shape)
 
     def _default_tiling(self) -> Tiling:
-        t = self.input.out_tiling()
+        t = self._pre_tiling()
         if self.axis is None:
             return tiling_mod.replicated(self.ndim)
         if self.keepdims and self.op not in _NO_KEEPDIMS:

@@ -9,19 +9,35 @@ per SURVEY.md §7 hard part 1 (dual paths):
   never come here — they are traced exprs whose sharding change makes
   GSPMD emit the all-to-all (see reshape.py, DistArray.retile).
 * The *general* shuffle — an arbitrary Python kernel emitting variable
-  extents — is not traceable. It runs as a host-side scatter-combine over
-  the source tiles (exactly the reference's semantics, which also ran
-  Python per tile), then re-enters the device world as a new DistArray.
-  The combiner is applied in deterministic source-tile order.
+  extents — is not traceable.  On BOTH modes the kernel runs once per
+  source tile with that tile's block (the reference's owner-computes
+  granularity).  The default ``mode='sharded'`` fetches each source
+  shard's block to host *individually*, routes the kernel's emissions
+  by extent intersection into per-target-shard blocks as they are
+  produced, and constructs the result shard-by-shard
+  (``jax.make_array_from_single_device_arrays``).  The full *source* is
+  never materialized on the host and emissions are folded into target
+  blocks immediately — peak host residency is one source block plus the
+  target's shards (transiently, while they are assembled).
+* ``mode='host'`` is the whole-array fallback: it gloms the source once
+  and scatters into a single host target buffer — simpler, and the
+  right choice when the target tiling is replicated anyway.  Nothing in
+  the package uses it.
+
+Combiner semantics match the reference's reducer-merge updates
+(SURVEY.md §7 hard part 3): updates are applied in deterministic order —
+source-tile order, then emission order — on both paths.
 """
 
 from __future__ import annotations
 
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from ..array import distarray as da
+from ..array import extent as extent_mod
 from ..array import tiling as tiling_mod
 from ..array.extent import TileExtent
 from ..array.tiling import Tiling
@@ -37,6 +53,18 @@ _COMBINERS = {
 }
 
 
+def _combiner_name(combiner: Any) -> str:
+    if isinstance(combiner, np.ufunc) or callable(combiner):
+        name = {np.add: "add", np.multiply: "mul", np.maximum: "max",
+                np.minimum: "min"}.get(combiner)
+        if name is None:
+            raise ValueError(f"unsupported combiner {combiner!r}")
+        combiner = name
+    if combiner not in _COMBINERS:
+        raise ValueError(f"unsupported combiner {combiner!r}")
+    return combiner
+
+
 def shuffle(source: Any,
             kernel: Callable[[TileExtent, np.ndarray],
                              Iterable[Tuple[TileExtent, np.ndarray]]],
@@ -45,49 +73,125 @@ def shuffle(source: Any,
             dtype: Any = None,
             combiner: Any = "add",
             tile_hint: Optional[Sequence[int]] = None,
-            kw: Optional[dict] = None) -> Expr:
+            tiling: Optional[Tiling] = None,
+            kw: Optional[dict] = None,
+            mode: str = "sharded") -> Expr:
     """Run ``kernel(extent, block, **kw)`` over every source tile; scatter
     its emitted ``(target_extent, data)`` pairs into the target with
     ``combiner``. Returns a ValExpr over the new DistArray (evaluated
-    eagerly — the kernel is arbitrary Python)."""
+    eagerly — the kernel is arbitrary Python).
+
+    ``mode='sharded'`` (default) never materializes the full source on
+    the host and builds the target shard-by-shard; ``mode='host'``
+    gloms the source and scatters into one host buffer.  The kernel is
+    invoked per source tile on both paths.
+    """
     source = as_expr(source)
     src = evaluate(source)
-    src_np = src.glom()
-
-    if isinstance(combiner, np.ufunc) or callable(combiner):
-        name = {np.add: "add", np.multiply: "mul", np.maximum: "max",
-                np.minimum: "min"}.get(combiner)
-        if name is None and combiner is not None:
-            raise ValueError(f"unsupported combiner {combiner!r}")
-        combiner = name
-    if combiner not in _COMBINERS:
-        raise ValueError(f"unsupported combiner {combiner!r}")
-    apply_update = _COMBINERS[combiner]
+    name = _combiner_name(combiner)
+    kw = kw or {}
 
     if target is not None:
-        target = as_expr(target)
-        tgt_np = evaluate(target).glom().copy()
-        out_shape = tgt_np.shape
-        out_dtype = tgt_np.dtype
-        out_tiling = evaluate(target).tiling
+        tgt = evaluate(as_expr(target))
+        out_shape = tgt.shape
+        out_dtype = tgt.dtype
+        out_tiling = tgt.tiling
     else:
         if target_shape is None:
             raise ValueError("shuffle needs target_shape or target")
+        tgt = None
         out_shape = tuple(int(s) for s in target_shape)
         out_dtype = np.dtype(dtype) if dtype is not None else src.dtype
-        tgt_np = np.zeros(out_shape, out_dtype)
-        out_tiling = None
+        if tiling is not None:
+            out_tiling = tiling
+        elif tile_hint is not None:
+            out_tiling = tiling_mod.from_tile_hint(out_shape, tile_hint,
+                                                   src.mesh)
+        else:
+            out_tiling = tiling_mod.default_tiling(out_shape, src.mesh)
+        out_tiling = tiling_mod.sanitize(out_tiling, out_shape, src.mesh)
 
-    kw = kw or {}
-    for ext in src.extents():
-        block = src_np[ext.to_slice()]
-        for t_ext, data in kernel(ext, block, **kw):
-            if not isinstance(t_ext, TileExtent):
-                t_ext = TileExtent(t_ext[0], t_ext[1], out_shape)
-            data = np.asarray(data, dtype=out_dtype)
-            if data.shape != t_ext.shape:
-                data = np.broadcast_to(data, t_ext.shape)
-            apply_update(tgt_np, t_ext.to_slice(), data)
-
-    result = da.from_numpy(tgt_np, tiling=out_tiling, tile_hint=tile_hint)
+    if mode == "sharded":
+        result = _shuffle_sharded(src, kernel, kw, out_shape, out_dtype,
+                                  out_tiling, name, tgt)
+    elif mode == "host":
+        result = _shuffle_host(src, kernel, kw, out_shape, out_dtype,
+                               out_tiling, name, tgt)
+    else:
+        raise ValueError(f"unknown shuffle mode {mode!r}")
     return ValExpr(result)
+
+
+def _normalize(t_ext, data, out_shape, out_dtype):
+    if not isinstance(t_ext, TileExtent):
+        t_ext = TileExtent(t_ext[0], t_ext[1], out_shape)
+    data = np.asarray(data, dtype=out_dtype)
+    if data.shape != t_ext.shape:
+        data = np.broadcast_to(data, t_ext.shape)
+    return t_ext, data
+
+
+def _emissions(blocks_iter, kernel, kw, out_shape, out_dtype):
+    """Yield normalized (target_extent, data) pairs in deterministic
+    order: source-tile order, then emission order."""
+    for s_ext, block in blocks_iter:
+        for t_ext, data in kernel(s_ext, block, **kw):
+            yield _normalize(t_ext, data, out_shape, out_dtype)
+
+
+def _fetched_blocks(src):
+    """One source tile at a time — only that region crosses to host."""
+    for s_ext in src.extents():
+        yield s_ext, src.fetch(s_ext)
+
+
+def _shuffle_sharded(src, kernel, kw, out_shape, out_dtype, out_tiling,
+                     combiner_name, tgt) -> da.DistArray:
+    """Distributed scatter-combine: fold emissions into per-target-shard
+    blocks as they stream out of the kernel, then place each shard."""
+    apply_update = _COMBINERS[combiner_name]
+    mesh = src.mesh
+    sharding = out_tiling.sharding(mesh)
+    # device -> region it stores (jax's ground truth, handles uneven
+    # splits and replicated axes — regions may repeat across devices)
+    idx_map = sharding.addressable_devices_indices_map(tuple(out_shape))
+    region_of = {dev: extent_mod.from_slice(idx, out_shape)
+                 for dev, idx in idx_map.items()}
+    blocks = {
+        r_ext: (tgt.fetch(r_ext).astype(out_dtype, copy=True) if tgt
+                else np.zeros(r_ext.shape, out_dtype))
+        for r_ext in set(region_of.values())}
+
+    # Emissions are applied immediately (nothing pins kernel outputs);
+    # deterministic because the emission stream is ordered and each
+    # target cell belongs to exactly one region block.
+    for t_ext, data in _emissions(_fetched_blocks(src), kernel, kw,
+                                  out_shape, out_dtype):
+        for r_ext, base in blocks.items():
+            isect = t_ext.intersection(r_ext)
+            if isect is None:
+                continue
+            piece = data[t_ext.offset_slice(isect)]
+            apply_update(base, isect.offset_from(r_ext).to_slice(), piece)
+
+    arrs = [jax.device_put(blocks[region_of[dev]], dev)
+            for dev in idx_map]
+    jarr = jax.make_array_from_single_device_arrays(
+        tuple(out_shape), sharding, arrs)
+    return da.DistArray(jarr, out_tiling, mesh)
+
+
+def _shuffle_host(src, kernel, kw, out_shape, out_dtype, out_tiling,
+                  combiner_name, tgt) -> da.DistArray:
+    """Whole-array fallback: glom the source once, scatter into a single
+    host target buffer."""
+    apply_update = _COMBINERS[combiner_name]
+    tgt_np = (tgt.glom().astype(out_dtype, copy=True) if tgt
+              else np.zeros(out_shape, out_dtype))
+    src_np = src.glom()
+    blocks_iter = ((s_ext, src_np[s_ext.to_slice()])
+                   for s_ext in src.extents())
+    for t_ext, data in _emissions(blocks_iter, kernel, kw, out_shape,
+                                  out_dtype):
+        apply_update(tgt_np, t_ext.to_slice(), data)
+    return da.from_numpy(tgt_np, tiling=out_tiling, mesh=src.mesh)

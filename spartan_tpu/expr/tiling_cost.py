@@ -82,6 +82,10 @@ def _operand_requirement(node: Expr, t: Tiling, child: Expr,
             return t
         return tiling_mod.replicated(child.ndim)  # broadcast operand
     if isinstance(node, (ReduceExpr, GeneralReduceExpr)):
+        pre_shape = getattr(node, "_pre_shape", child.shape)
+        if child.shape != pre_shape:
+            # broadcast operand of a fused pre-reduce tree
+            return tiling_mod.replicated(child.ndim)
         if node.axis is None:
             return None  # full reduction reads any layout equally
         t_in = t

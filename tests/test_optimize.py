@@ -89,3 +89,70 @@ def test_all_passes_off_still_correct():
     ex = st.from_numpy(x)
     out = ((ex * 2.0 + 1.0).sum()).glom()
     np.testing.assert_allclose(out, (x * 2 + 1).sum(), rtol=1e-5)
+
+
+def test_reduce_fusion_folds_map_into_reduce():
+    """VERDICT r1 #3: the reduce-fusion pass must actually shrink the
+    DAG — (a*b).sum() becomes ONE fused ReduceExpr, no MapExpr left."""
+    from spartan_tpu.expr.reduce import ReduceExpr
+
+    a = st.from_numpy(np.arange(32, dtype=np.float32).reshape(8, 4))
+    b = st.from_numpy(np.ones((8, 4), np.float32) * 2.0)
+    expr = (a * b + 1.0).sum(axis=0)
+    dag = optimize(expr)
+    assert isinstance(dag, ReduceExpr)
+    maps = [n for n in dag_nodes(dag) if isinstance(n, MapExpr)]
+    assert not maps, f"map producers not folded: {maps}"
+    assert count_ops(dag.pre) == 2  # mul, add
+    oracle = (np.arange(32, dtype=np.float32).reshape(8, 4) * 2.0
+              + 1.0).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(expr.glom()), oracle, rtol=1e-6)
+
+
+def test_reduce_fusion_toggle_changes_node_count():
+    """--opt_reduce_fusion must change the DAG node count (the round-1
+    pass was a no-op); results stay oracle-equal either way."""
+    from spartan_tpu.expr.reduce import ReduceExpr
+
+    a_np = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    a = st.from_numpy(a_np)
+
+    FLAGS.opt_reduce_fusion = False
+    expr_off = (a * a).sum()
+    dag_off = optimize(expr_off)
+    n_off = len(dag_nodes(dag_off))
+    assert any(isinstance(n, MapExpr) for n in dag_nodes(dag_off))
+    off_val = float(expr_off.glom())
+
+    FLAGS.opt_reduce_fusion = True
+    expr_on = (a * a).sum()
+    dag_on = optimize(expr_on)
+    n_on = len(dag_nodes(dag_on))
+    assert n_on < n_off
+    assert isinstance(dag_on, ReduceExpr)
+    assert not any(isinstance(n, MapExpr) for n in dag_nodes(dag_on))
+    np.testing.assert_allclose(float(expr_on.glom()), off_val, rtol=1e-6)
+    np.testing.assert_allclose(off_val, float((a_np * a_np).sum()),
+                               rtol=1e-5)
+
+
+def test_reduce_fusion_dedups_shared_inputs():
+    from spartan_tpu.expr.reduce import ReduceExpr
+
+    x = st.from_numpy(np.ones((8, 8), np.float32))
+    expr = ((x + x) * (x + 1.0)).sum(axis=1)
+    dag = optimize(expr)
+    assert isinstance(dag, ReduceExpr)
+    array_inputs = [c for c in dag.inputs if not hasattr(c, "pyvalue")]
+    assert len(array_inputs) == 1  # x deduped across the fused tree
+
+
+def test_reduce_fusion_broadcast_operand():
+    """Fused pre-reduce with a broadcast (vector) operand stays
+    oracle-equal under sharded evaluation."""
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    v = np.arange(8, dtype=np.float32)
+    ex, ev = st.from_numpy(x), st.from_numpy(v)
+    expr = (ex * ev).sum(axis=1)
+    np.testing.assert_allclose(np.asarray(expr.glom()),
+                               (x * v).sum(axis=1), rtol=1e-5)

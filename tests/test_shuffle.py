@@ -1,0 +1,133 @@
+"""General shuffle: distributed (sharded) scatter-combine vs NumPy
+oracle (SURVEY.md §2.3 shuffle; §7 hard part 1 dual paths). The key
+claim (VERDICT r1 #2): the default path never materializes the full
+source or target array on the host."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+from spartan_tpu.array.distarray import DistArray
+from spartan_tpu.array.extent import TileExtent
+
+
+def _transpose_kernel(ext, block):
+    """Emit the block transposed into the swapped region."""
+    ul = (ext.ul[1], ext.ul[0])
+    lr = (ext.lr[1], ext.lr[0])
+    yield TileExtent(ul, lr), block.T
+
+
+def _colsum_kernel(ext, block):
+    """Emit each tile's column sums into a single (1, ncols) strip —
+    overlapping targets across tiles, exercising the add-combiner."""
+    yield TileExtent((0, ext.ul[1]), (1, ext.lr[1])), \
+        block.sum(axis=0, keepdims=True)
+
+
+def test_sharded_shuffle_transpose_oracle(mesh1d):
+    rng = np.random.RandomState(0)
+    a = rng.rand(16, 12).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    out = st.shuffle(ea, _transpose_kernel, target_shape=(12, 16),
+                     combiner="set")
+    np.testing.assert_allclose(np.asarray(out.glom()), a.T, rtol=1e-6)
+
+
+def test_sharded_shuffle_add_overlapping(mesh1d):
+    rng = np.random.RandomState(1)
+    a = rng.rand(24, 8).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    out = st.shuffle(ea, _colsum_kernel, target_shape=(1, 8),
+                     combiner="add")
+    np.testing.assert_allclose(np.asarray(out.glom()),
+                               a.sum(axis=0, keepdims=True), rtol=1e-5)
+
+
+def test_sharded_shuffle_never_materializes_full_array(mesh1d,
+                                                       monkeypatch):
+    """The done-criterion from VERDICT r1 #2: an 8-device shuffle of a
+    row-sharded array must not glom the source or fetch regions larger
+    than one tile."""
+    rng = np.random.RandomState(2)
+    a = rng.rand(32, 8).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    src = ea.evaluate()
+    tile_size = max(e.size for e in src.extents())
+
+    def no_glom(self):
+        raise AssertionError("sharded shuffle must not glom()")
+
+    real_fetch = DistArray.fetch
+
+    def bounded_fetch(self, region):
+        if not isinstance(region, TileExtent):
+            raise AssertionError("shuffle fetch must use tile extents")
+        assert region.size <= tile_size, \
+            f"fetched {region.size} > tile size {tile_size}"
+        return real_fetch(self, region)
+
+    monkeypatch.setattr(DistArray, "glom", no_glom)
+    monkeypatch.setattr(DistArray, "fetch", bounded_fetch)
+    out = st.shuffle(src, _transpose_kernel, target_shape=(8, 32),
+                     combiner="set")
+    monkeypatch.undo()
+    np.testing.assert_allclose(np.asarray(out.glom()), a.T, rtol=1e-6)
+    # and the result is genuinely sharded over the target tiling
+    shards = out.evaluate().jax_array.addressable_shards
+    assert len({s.device for s in shards}) == 8
+
+
+def test_shuffle_into_existing_target(mesh1d):
+    rng = np.random.RandomState(3)
+    a = rng.rand(16, 4).astype(np.float32)
+    base = rng.rand(16, 4).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    eb = st.from_numpy(base, tiling=tiling.row(2))
+
+    def double_kernel(ext, block):
+        yield ext, 2.0 * block
+
+    out = st.shuffle(ea, double_kernel, target=eb, combiner="add")
+    np.testing.assert_allclose(np.asarray(out.glom()), base + 2.0 * a,
+                               rtol=1e-5)
+
+
+def test_host_mode_matches_sharded(mesh1d):
+    rng = np.random.RandomState(4)
+    a = rng.rand(16, 6).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    sharded = st.shuffle(ea, _transpose_kernel, target_shape=(6, 16),
+                         combiner="set")
+    host = st.shuffle(ea, _transpose_kernel, target_shape=(6, 16),
+                      combiner="set", mode="host")
+    np.testing.assert_allclose(np.asarray(sharded.glom()),
+                               np.asarray(host.glom()), rtol=1e-6)
+
+
+def test_shuffle_non_divisible_target(mesh2d):
+    """Target shape not divisible by the mesh: sanitize drops the
+    offending axes; result still matches the oracle."""
+    rng = np.random.RandomState(5)
+    a = rng.rand(12, 10).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    out = st.shuffle(ea, _transpose_kernel, target_shape=(10, 12),
+                     combiner="set")
+    np.testing.assert_allclose(np.asarray(out.glom()), a.T, rtol=1e-6)
+
+
+def test_shuffle_min_max_combiners(mesh1d):
+    rng = np.random.RandomState(6)
+    a = rng.rand(16, 4).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+
+    def rowmax_kernel(ext, block):
+        yield TileExtent((0, 0), (1, 4)), block.max(axis=0, keepdims=True)
+
+    out = st.shuffle(ea, rowmax_kernel, target_shape=(1, 4),
+                     combiner="max")
+    np.testing.assert_allclose(np.asarray(out.glom()),
+                               a.max(axis=0, keepdims=True), rtol=1e-6)
