@@ -167,7 +167,10 @@ def config5_sparse(st):
     t0 = time.perf_counter()
     u, s, vt = ssvd(a, rank=32)
     ssvd_t = time.perf_counter() - t0
+    # record which spmv path the default dispatch used, so the number is
+    # attributable to the same code path the multi-chip tests exercise
     return {"pagerank_sec_per_iter": pr_iter, "pagerank_edges": n * deg,
+            "pagerank_spmv_path": links.transition().default_impl(),
             "ssvd_seconds": ssvd_t, "ssvd_shape": [m_rows, 512]}
 
 

@@ -38,15 +38,21 @@ def _todense_kernel(data, rows, cols, *, n, m):
     return flat.reshape(n, m)
 
 
+def _contrib_segsum(data, rows, cols, x, n, impl=None):
+    """Shared SpMV body: gather operand rows, scale by entry values,
+    segment-merge into output rows (out-of-range padding rows drop)."""
+    gathered = x[cols]
+    contrib = data * gathered if gathered.ndim == 1 \
+        else data[:, None] * gathered
+    if impl is not None:
+        return segment_sum(contrib, rows, n, impl=impl, sorted_ids=True)
+    return jax.ops.segment_sum(contrib, rows, num_segments=n,
+                               indices_are_sorted=True)
+
+
 @functools.partial(jax.jit, static_argnames=("n", "impl"))
 def _spmv_kernel(data, rows, cols, x, *, n, impl):
-    gathered = x[cols]
-    if gathered.ndim == 1:
-        contrib = data * gathered
-    else:
-        contrib = data[:, None] * gathered
-    return segment_sum(contrib, rows, n, impl=impl,
-                       sorted_ids=True)
+    return _contrib_segsum(data, rows, cols, x, n, impl=impl)
 
 
 @functools.partial(jax.jit, static_argnames=("shape",))
@@ -85,6 +91,50 @@ def _windowed_spmv_jit(pdata, pcols, ids2d, wb, x, *, num_segments,
 @jax.jit
 def _scale_rows_kernel(data, rows, ext_scale):
     return data * ext_scale[rows]
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_spmv_fn(mesh, n, x_ndim):
+    """Explicit owner-computes SpMV for entry-sharded matrices — the
+    multi-chip default. Each device segment-sums its local entries'
+    contributions (out-of-range padding rows drop), then an all-reduce
+    over the entry axis merges the partials: exactly the reference's
+    per-tile sparse kernel + reducer-merge (SURVEY.md §2.2
+    sparse_update), lowered to segment_sum + psum over ICI.
+
+    lru_cache keyed on (mesh, n, ndim) keeps one jitted program per
+    configuration (closures would defeat jax's jit cache)."""
+    from jax import shard_map
+
+    from ..parallel.mesh import AXIS_ROW
+
+    def kern(d, r, c, xx):
+        part = _contrib_segsum(d, r, c, xx, n)
+        return jax.lax.psum(part, AXIS_ROW)
+
+    espec = jax.sharding.PartitionSpec(AXIS_ROW)
+    rspec = jax.sharding.PartitionSpec(*([None] * x_ndim))
+    mapped = shard_map(kern, mesh=mesh,
+                       in_specs=(espec, espec, espec, rspec),
+                       out_specs=rspec)
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_rsums_fn(mesh, n):
+    from jax import shard_map
+
+    from ..parallel.mesh import AXIS_ROW
+
+    def kern(d, r):
+        part = jax.ops.segment_sum(d, r, num_segments=n,
+                                   indices_are_sorted=True)
+        return jax.lax.psum(part, AXIS_ROW)
+
+    espec = jax.sharding.PartitionSpec(AXIS_ROW)
+    mapped = shard_map(kern, mesh=mesh, in_specs=(espec, espec),
+                       out_specs=jax.sharding.PartitionSpec(None))
+    return jax.jit(mapped)
 
 
 def _entry_tiling(mesh=None) -> Tiling:
@@ -241,6 +291,16 @@ class SparseDistArray:
 
         return self._can_window() and _pallas_available()
 
+    def default_impl(self, x_ndim: int = 1) -> str:
+        """The spmv path the default dispatch selects for an operand of
+        rank ``x_ndim`` (benchmarks record this so timings stay
+        attributable to the code path actually measured)."""
+        if x_ndim == 1 and self._default_windowed():
+            return "windowed"
+        if mesh_mod.device_count(self.mesh) > 1:
+            return "sharded"
+        return "bcoo"
+
     def spmv_traced(self, x: jax.Array) -> jax.Array:
         """Windowed-kernel matvec, traceable inside any jit (including
         ``lax.fori_loop`` bodies, where XLA's own scatter lowering
@@ -253,13 +313,17 @@ class SparseDistArray:
     def spmv(self, x: Any, impl: Optional[str] = None) -> jax.Array:
         """y = A @ x for dense x (n,) or (n, d).
 
-        Default: the windowed Pallas path on TPU (vector x), else BCOO
-        matvec; ``impl`` forces a path ('windowed' | 'bcoo' | 'xla' |
-        'onehot' | 'pallas' segment-merge ablations)."""
+        Default: the windowed Pallas path on a single TPU (vector x);
+        on a multi-device mesh the explicit entry-sharded
+        segment-sum + psum path ('sharded'); else BCOO matvec.
+        ``impl`` forces a path ('windowed' | 'sharded' | 'bcoo' |
+        'xla' | 'onehot' | 'pallas' segment-merge ablations)."""
         x = x.jax_array if isinstance(x, DistArray) else jnp.asarray(x)
         if impl is None:
-            impl = ("windowed" if x.ndim == 1 and self._default_windowed()
-                    else "bcoo")
+            impl = self.default_impl(x.ndim)
+        if impl == "sharded":
+            fn = _sharded_spmv_fn(self.mesh, self.shape[0], x.ndim)
+            return fn(self.data, self.rows, self.cols, x)
         if impl == "windowed":
             if x.ndim != 1:
                 raise ValueError(
@@ -286,6 +350,9 @@ class SparseDistArray:
 
     def rsums(self) -> jax.Array:
         """Row sums (out-degree weights for PageRank)."""
+        if mesh_mod.device_count(self.mesh) > 1:
+            return _sharded_rsums_fn(self.mesh, self.shape[0])(
+                self.data, self.rows)
         return _rsums_kernel(self.data, self.rows, n=self.shape[0])
 
     def transition(self) -> "SparseDistArray":

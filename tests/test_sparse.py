@@ -253,3 +253,73 @@ def test_transition_cached_and_clearable():
                                rtol=1e-6)
     links.clear_cache()
     assert links.transition() is not t1
+
+
+# -- multi-chip sparse (VERDICT r1 #4) -----------------------------------
+
+
+def test_sparse_entries_genuinely_sharded(mesh1d):
+    """Entries must really live sharded over the mesh's entry axis —
+    one distinct shard per device, together covering nse."""
+    import scipy.sparse as sp
+
+    rng = np.random.RandomState(7)
+    mat = sp.random(64, 64, density=0.05, random_state=rng, format="coo")
+    a = SparseDistArray.from_scipy(mat)
+    shards = a.data.addressable_shards
+    assert len({s.device for s in shards}) == 8
+    sizes = [int(s.data.shape[0]) for s in shards]
+    assert sum(sizes) == a.nse
+    assert max(sizes) - min(sizes) == 0  # padded to an even split
+
+
+@pytest.mark.parametrize("fixture", ["mesh1d", "mesh2d"])
+def test_spmv_sharded_matches_oracle(fixture, request):
+    """The explicit segment-sum+psum SpMV is the multi-device default
+    and matches scipy on 8x1 and 4x2 meshes (the 4x2 case exercises
+    entry replication over the unused y axis)."""
+    import scipy.sparse as sp
+
+    request.getfixturevalue(fixture)
+    rng = np.random.RandomState(8)
+    n = 96
+    mat = sp.random(n, n, density=0.03, random_state=rng, format="coo")
+    a = SparseDistArray.from_scipy(mat)
+    x = rng.rand(n).astype(np.float32)
+    y_default = np.asarray(jax.device_get(a.spmv(x)))
+    y_forced = np.asarray(jax.device_get(a.spmv(x, impl="sharded")))
+    expect = mat.tocsr() @ x
+    np.testing.assert_allclose(y_default, expect, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(y_forced, expect, rtol=1e-4, atol=1e-6)
+    # matrix operand (n, d)
+    X = rng.rand(n, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(a.spmv(X, impl="sharded"))),
+        mat.tocsr() @ X, rtol=1e-4, atol=1e-6)
+
+
+def test_rsums_sharded(mesh2d):
+    import scipy.sparse as sp
+
+    rng = np.random.RandomState(9)
+    mat = sp.random(40, 30, density=0.1, random_state=rng, format="coo")
+    a = SparseDistArray.from_scipy(mat)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(a.rsums())),
+        np.asarray(mat.tocsr().sum(axis=1)).ravel(), rtol=1e-5)
+
+
+def test_pagerank_multichip(mesh1d):
+    """PageRank through the sharded SpMV path (no windowed kernel on a
+    multi-device mesh) reproduces the star-graph structure."""
+    from spartan_tpu.examples.pagerank import pagerank
+
+    n = 8
+    rows = np.concatenate([np.arange(1, n), [0]])
+    cols = np.concatenate([np.zeros(n - 1, np.int64), [1]])
+    links = SparseDistArray.from_coo(rows, cols,
+                                     np.ones(n, np.float32), (n, n))
+    ranks = pagerank(links, num_iter=40)
+    assert ranks.argmax() == 0
+    assert ranks[1] > ranks[2]
+    np.testing.assert_allclose(ranks.sum(), 1.0, rtol=1e-3)
