@@ -135,6 +135,30 @@ def block(ndim: int) -> Tiling:
     return Tiling((AXIS_ROW, AXIS_COL) + (None,) * (ndim - 2))
 
 
+def row_t(ndim: int) -> Tiling:
+    """Transposed row tiling: the leading axis sharded on the *col* mesh
+    axis (``P('y', ...)``) — lets consumers like transpose line up
+    without an all-to-all (smart-tiling candidate)."""
+    if ndim == 0:
+        return Tiling(())
+    return Tiling((AXIS_COL,) + (None,) * (ndim - 1))
+
+
+def col_t(ndim: int) -> Tiling:
+    """Transposed col tiling: the second axis sharded on the *row* mesh
+    axis (``P(None, 'x')``)."""
+    if ndim < 2:
+        return replicated(ndim)
+    return Tiling((None, AXIS_ROW) + (None,) * (ndim - 2))
+
+
+def block_t(ndim: int) -> Tiling:
+    """Transposed block tiling (``P('y', 'x')``)."""
+    if ndim < 2:
+        return row_t(ndim)
+    return Tiling((AXIS_COL, AXIS_ROW) + (None,) * (ndim - 2))
+
+
 def flat_row(ndim: int) -> Tiling:
     """Row tiling using both mesh axes on axis 0 — maximal 1-D split.
 

@@ -36,6 +36,10 @@ class DotExpr(Expr):
         self.a = a
         self.b = b
         self.precision = precision
+        # contraction placement chosen by smart tiling (tiling_cost):
+        # None = gathered contraction; a mesh axis = contraction
+        # sharded there, merged by an output psum
+        self._dot_strategy = None
         if a.ndim == 1 and b.ndim == 1:
             shape: Tuple[int, ...] = ()
         elif a.ndim == 1:
@@ -56,17 +60,30 @@ class DotExpr(Expr):
         av = self.a.lower(env)
         bv = self.b.lower(env)
         mesh = mesh_mod.get_mesh()
-        if self.a.ndim == 2 and self.b.ndim == 2:
-            # constrain operands so GSPMD computes C[x,y] blocks locally:
-            # A row-sharded on x, B col-sharded on y, contraction gathered
+        if (self.a.ndim == 2 and self.b.ndim == 2
+                and self._forced_tiling is not None):
+            # Smart tiling chose this GEMM's plan: output grid
+            # (m_r, m_c) with the contraction on mesh axis k (or
+            # gathered when k is None) — A sharded (m_r, k),
+            # B (k, m_c); for sharded k GSPMD inserts the merging
+            # all-reduce. The cost model prices operand resharding and
+            # the psum with exactly this rule (tiling_cost.py). Without
+            # a plan (pass off, or the plan agreed with the natural
+            # layout) GSPMD negotiates from the operands' own
+            # shardings — the reference's no-smart-tiling behavior
+            # (tiles computed where they live).
+            m_r, m_c = self._forced_tiling.axes[:2]
+            k = self._dot_strategy
             av = jax.lax.with_sharding_constraint(
-                av, tiling_mod.row(2).sharding(mesh))
+                av, Tiling((m_r, k)).sharding(mesh))
             bv = jax.lax.with_sharding_constraint(
-                bv, tiling_mod.col(2).sharding(mesh))
+                bv, Tiling((k, m_c)).sharding(mesh))
         return jnp.dot(av, bv, precision=self.precision)
 
     def _sig(self, ctx) -> Tuple:
-        return ("dot", self.precision, ctx.of(self.a), ctx.of(self.b))
+        # the strategy changes the lowering, so it must key the cache
+        return ("dot", self.precision, self._dot_strategy,
+                ctx.of(self.a), ctx.of(self.b))
 
     def _default_tiling(self) -> Tiling:
         if self.ndim == 2:

@@ -49,14 +49,23 @@ def _parallelism(t: Tiling, mesh) -> int:
 
 
 def candidates(node: Expr, mesh) -> List[Tiling]:
-    """Candidate output tilings for a node (divisible ones only)."""
+    """Candidate output tilings for a node (divisible ones only):
+    row / col / block plus their mesh-axis-swapped (transposed)
+    variants, and replicated."""
     nd = node.ndim
     cands = {tiling_mod.replicated(nd)}
     if nd >= 1:
         cands.add(tiling_mod.row(nd))
+        if mesh.shape.get(tiling_mod.AXIS_COL, 1) > 1:
+            cands.add(tiling_mod.row_t(nd))
     if nd >= 2:
         cands.add(tiling_mod.col(nd))
         cands.add(tiling_mod.block(nd))
+        if mesh.shape.get(tiling_mod.AXIS_ROW, 1) > 1:
+            cands.add(tiling_mod.col_t(nd))
+        if (mesh.shape.get(tiling_mod.AXIS_ROW, 1) > 1
+                and mesh.shape.get(tiling_mod.AXIS_COL, 1) > 1):
+            cands.add(tiling_mod.block_t(nd))
     out = []
     for t in cands:
         if tiling_mod.sanitize(t, node.shape, mesh) == t:
@@ -64,13 +73,42 @@ def candidates(node: Expr, mesh) -> List[Tiling]:
     return out or [tiling_mod.replicated(nd)]
 
 
+def _axis_size(mesh, ax) -> int:
+    if ax is None:
+        return 1
+    if isinstance(ax, tuple):
+        n = 1
+        for a in ax:
+            n *= mesh.shape.get(a, 1)
+        return n
+    return mesh.shape.get(ax, 1)
+
+
 def reshard_cost(src: Tiling, dst: Tiling, nbytes: float, mesh) -> float:
+    """Per-chip bytes to move from ``src`` to ``dst`` layout.
+
+    Axis-wise: refining an unsharded axis (None -> mesh axis) is a
+    local slice (0 bytes); coarsening (mesh axis -> None) all-gathers
+    over that axis; moving an axis to a *different* mesh axis is an
+    all-to-all over the involved devices."""
     if src.axes == dst.axes:
         return 0.0
     if not src.sharded_axes():  # replicated source: local slicing only
         return 0.0
-    n = _mesh_n(mesh)
-    return nbytes * (n - 1) / max(n, 1)
+    cost = 0.0
+    a2a = False
+    for s_ax, d_ax in zip(src.axes, dst.axes):
+        if s_ax == d_ax or s_ax is None:
+            continue
+        if d_ax is None:
+            n = _axis_size(mesh, s_ax)
+            cost += nbytes * (n - 1) / max(n, 1)
+        else:
+            a2a = True
+    if a2a:
+        n = _mesh_n(mesh)
+        cost = max(cost, nbytes * (n - 1) / max(n, 1))
+    return cost
 
 
 def _operand_requirement(node: Expr, t: Tiling, child: Expr,
@@ -98,73 +136,130 @@ def _operand_requirement(node: Expr, t: Tiling, child: Expr,
         return t.transpose(tuple(int(i) for i in inv))
     if isinstance(node, SliceExpr):
         return None
-    from .dot import DotExpr
-
-    if isinstance(node, DotExpr) and node.a.ndim == 2 and node.b.ndim == 2:
-        # the lowering constrains operands itself (row x col)
-        return tiling_mod.row(2) if child_idx == 0 else tiling_mod.col(2)
+    # DotExpr is strategy-searched inline in assign_tilings.build
     return None
 
 
+def _dot_strategies(t: Tiling, mesh) -> List[Optional[str]]:
+    """Contraction placements for a GEMM with output grid (m_r, m_c):
+    None = contraction replicated (gathered operands); a mesh axis =
+    contraction sharded there, merged by an output psum."""
+    used = {a for a in t.axes[:2] if a is not None}
+    out: List[Optional[str]] = [None]
+    for ax in mesh.axis_names:
+        if ax not in used and mesh.shape.get(ax, 1) > 1:
+            out.append(ax)
+    return out
+
+
 def assign_tilings(root: Expr) -> Expr:
+    from .dot import DotExpr, DotShardMapExpr
+
     mesh = mesh_mod.get_mesh()
     if _mesh_n(mesh) <= 1:
         return root  # single device: everything is replicated anyway
 
-    # cost_table[node_id][tiling] = (cost, per-child chosen tilings)
-    table: Dict[int, Dict[Tiling, Tuple[float, Tuple]] ] = {}
+    # cost_table[node_id][tiling] = (cost, per-child picks, extra)
+    # where extra is the chosen contraction strategy for GEMM nodes
+    table: Dict[int, Dict[Tiling, Tuple[float, Tuple, Optional[str]]]] = {}
 
     def nbytes(e: Expr) -> float:
         return float(e.size) * e.dtype.itemsize
+
+    def best_child(c: Expr, req: Optional[Tiling]
+                   ) -> Tuple[float, Optional[Tiling]]:
+        best_cost = None
+        best_pick = None
+        for tc, entry in table[c._id].items():
+            move = (0.0 if req is None
+                    else reshard_cost(tc, req, nbytes(c), mesh))
+            total = entry[0] + move
+            if best_cost is None or total < best_cost:
+                best_cost, best_pick = total, tc
+        return best_cost or 0.0, best_pick
 
     def build(node: Expr) -> None:
         if node._id in table:
             return
         for c in node.children():
             build(c)
-        entries: Dict[Tiling, Tuple[float, Tuple]] = {}
+        entries: Dict[Tiling, Tuple[float, Tuple, Optional[str]]] = {}
         if isinstance(node, (ValExpr, ScalarExpr)):
-            entries[node.out_tiling()] = (0.0, ())
+            entries[node.out_tiling()] = (0.0, (), None)
             table[node._id] = entries
             return
         kids = node.children()
+        is_gemm = (isinstance(node, DotExpr)
+                   and node.a.ndim == 2 and node.b.ndim == 2)
         for t in candidates(node, mesh):
+            compute = (nbytes(node) * _COMPUTE_WEIGHT
+                       / _parallelism(t, mesh))
+            if is_gemm:
+                # search contraction strategies: operand layouts are
+                # A (m_r, k), B (k, m_c); k=None gathers the
+                # contraction, k=mesh-axis shards it and pays an
+                # output psum — mirroring DotExpr._lower exactly.
+                # A sharded contraction multiplies the compute
+                # parallelism: the FLOPs spread over output grid x k.
+                m_r, m_c = t.axes[0], t.axes[1]
+                best = None
+                for s in _dot_strategies(t, mesh):
+                    ca, pa = best_child(kids[0], Tiling((m_r, s)))
+                    cb, pb = best_child(kids[1], Tiling((s, m_c)))
+                    psum = 0.0
+                    if s is not None:
+                        ns = _axis_size(mesh, s)
+                        psum = nbytes(node) * (ns - 1) / ns
+                    flops = (nbytes(node) * _COMPUTE_WEIGHT
+                             / (_parallelism(t, mesh)
+                                * _axis_size(mesh, s)))
+                    tot = ca + cb + psum + flops
+                    if best is None or tot < best[0]:
+                        best = (tot, (pa, pb), s)
+                entries[t] = (best[0], best[1], best[2])
+                continue
             comm = 0.0
             picks: List[Tiling] = []
             for i, c in enumerate(kids):
                 req = _operand_requirement(node, t, c, i)
-                best_cost = None
-                best_pick = None
-                for tc, (ccost, _) in table[c._id].items():
-                    move = (0.0 if req is None
-                            else reshard_cost(tc, req, nbytes(c), mesh))
-                    total = ccost + move
-                    if best_cost is None or total < best_cost:
-                        best_cost, best_pick = total, tc
-                comm += best_cost or 0.0
-                picks.append(best_pick)
-            compute = (nbytes(node) * _COMPUTE_WEIGHT
-                       / _parallelism(t, mesh))
-            entries[t] = (comm + compute, tuple(picks))
+                ccost, pick = best_child(c, req)
+                comm += ccost
+                picks.append(pick)
+            entries[t] = (comm + compute, tuple(picks), None)
         table[node._id] = entries
 
-    def commit(node: Expr, t: Tiling) -> None:
+    def commit(node: Expr, t: Tiling, force: bool) -> None:
         if isinstance(node, (ValExpr, ScalarExpr)):
             return
-        if node._forced_tiling is None and t is not None:
-            node._forced_tiling = t
         entry = table[node._id].get(t)
+        # Constrain only MATERIALIZATION points: GEMMs (whose lowering
+        # derives operand layouts from the chosen plan) and the root.
+        # Forcing every intermediate (e.g. a transpose) pins layouts XLA
+        # would otherwise optimize through — measured 25% slower and 2x
+        # the collectives on the dot-T-dot chain (benchmarks/tiling_ab).
+        # A plan equal to the node's natural behavior is also skipped: a
+        # redundant with_sharding_constraint is not free, it steers
+        # XLA's propagation pass into worse solutions.
+        strategy = entry[2] if entry is not None else None
+        is_gemm = isinstance(node, (DotExpr, DotShardMapExpr))
+        nondefault = t is not None and t != node._default_tiling()
+        if node._forced_tiling is None and (
+                (force and nondefault)
+                or (is_gemm and (nondefault or strategy is not None))):
+            node._forced_tiling = t
+            if is_gemm:
+                node._dot_strategy = strategy
         if entry is None:
             return
         for c, tc in zip(node.children(), entry[1]):
             if tc is not None:
-                commit(c, tc)
+                commit(c, tc, False)
 
     roots = root.elements if isinstance(root, TupleExpr) else (root,)
     for r in roots:
         build(r)
         best_t = min(table[r._id], key=lambda t: table[r._id][t][0])
-        commit(r, best_t)
+        commit(r, best_t, True)
     return root
 
 

@@ -30,9 +30,9 @@ def profile_trace(trace_dir: Optional[str] = None) -> Iterator[None]:
     log_info("profiler trace written to %s", trace_dir)
 
 
-def cost_analysis(expr) -> Dict[str, float]:
-    """FLOPs / bytes-accessed estimate of an expr's compiled program
-    (the per-expr HLO cost hook of SURVEY.md §5)."""
+def _compiled(expr):
+    """Optimize + lower + compile an expr exactly the way ``evaluate``
+    would, returning the jax Compiled object (for HLO inspection)."""
     from ..expr import base as expr_base
     from ..expr.optimize import optimize
 
@@ -48,11 +48,22 @@ def cost_analysis(expr) -> Dict[str, float]:
 
     lowered = jax.jit(traced).lower(
         *[expr_base._leaf_arg(l) for l in leaves])
-    compiled = lowered.compile()
-    analysis = compiled.cost_analysis()
+    return lowered.compile()
+
+
+def cost_analysis(expr) -> Dict[str, float]:
+    """FLOPs / bytes-accessed estimate of an expr's compiled program
+    (the per-expr HLO cost hook of SURVEY.md §5)."""
+    analysis = _compiled(expr).cost_analysis()
     if isinstance(analysis, list):
         analysis = analysis[0] if analysis else {}
     return dict(analysis or {})
+
+
+def hlo_text(expr) -> str:
+    """Compiled (post-SPMD-partitioning) HLO of an expr — lets tests
+    and benchmarks count the collectives a plan actually emits."""
+    return _compiled(expr).as_text()
 
 
 def benchmark(fn: Callable[[], Any], iters: int = 5,
