@@ -36,10 +36,13 @@ class DotExpr(Expr):
         self.a = a
         self.b = b
         self.precision = precision
-        # contraction placement chosen by smart tiling (tiling_cost):
-        # None = gathered contraction; a mesh axis = contraction
-        # sharded there, merged by an output psum
-        self._dot_strategy = None
+        # smart-tiling plan (tiling_cost): (output Tiling, strategy)
+        # where strategy None = gathered contraction and a mesh axis =
+        # contraction sharded there, merged by an output psum.
+        # Recorded even when the chosen grid equals the default, so the
+        # operand placement always reaches _lower without a redundant
+        # output constraint.
+        self._dot_plan = None
         if a.ndim == 1 and b.ndim == 1:
             shape: Tuple[int, ...] = ()
         elif a.ndim == 1:
@@ -49,6 +52,11 @@ class DotExpr(Expr):
         else:
             shape = (a.shape[0], b.shape[1])
         super().__init__(shape, np.result_type(a.dtype, b.dtype))
+
+    @property
+    def _dot_strategy(self):
+        """Contraction placement from the plan (None = gathered)."""
+        return self._dot_plan[1] if self._dot_plan is not None else None
 
     def children(self) -> Tuple[Expr, ...]:
         return (self.a, self.b)
@@ -61,19 +69,18 @@ class DotExpr(Expr):
         bv = self.b.lower(env)
         mesh = mesh_mod.get_mesh()
         if (self.a.ndim == 2 and self.b.ndim == 2
-                and self._forced_tiling is not None):
+                and self._dot_plan is not None):
             # Smart tiling chose this GEMM's plan: output grid
             # (m_r, m_c) with the contraction on mesh axis k (or
             # gathered when k is None) — A sharded (m_r, k),
             # B (k, m_c); for sharded k GSPMD inserts the merging
             # all-reduce. The cost model prices operand resharding and
             # the psum with exactly this rule (tiling_cost.py). Without
-            # a plan (pass off, or the plan agreed with the natural
-            # layout) GSPMD negotiates from the operands' own
+            # a plan (pass off) GSPMD negotiates from the operands' own
             # shardings — the reference's no-smart-tiling behavior
             # (tiles computed where they live).
-            m_r, m_c = self._forced_tiling.axes[:2]
-            k = self._dot_strategy
+            plan_t, k = self._dot_plan
+            m_r, m_c = plan_t.axes[:2]
             av = jax.lax.with_sharding_constraint(
                 av, Tiling((m_r, k)).sharding(mesh))
             bv = jax.lax.with_sharding_constraint(
@@ -81,8 +88,10 @@ class DotExpr(Expr):
         return jnp.dot(av, bv, precision=self.precision)
 
     def _sig(self, ctx) -> Tuple:
-        # the strategy changes the lowering, so it must key the cache
-        return ("dot", self.precision, self._dot_strategy,
+        # the plan changes the lowering, so it must key the cache
+        plan = (None if self._dot_plan is None
+                else (self._dot_plan[0].axes, self._dot_plan[1]))
+        return ("dot", self.precision, plan,
                 ctx.of(self.a), ctx.of(self.b))
 
     def _default_tiling(self) -> Tiling:

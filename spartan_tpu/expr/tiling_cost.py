@@ -36,6 +36,14 @@ from .slice import SliceExpr
 
 _COMPUTE_WEIGHT = 4.0  # bytes-equivalent per element of local compute
 
+# Tie-break weight for operand-reshard bytes in GEMM plans. On square
+# meshes a contraction-sharded plan (output psum, operands in place)
+# can tie a gathered plan byte-for-byte; physically the psum plan wins
+# — operand gathers sit on the critical path before the MXU and
+# replicate operand memory, while the output all-reduce pipelines with
+# the epilogue. Small enough to never override a real byte difference.
+_OP_MOVE_EPS = 2.0 ** -20
+
 
 def _mesh_n(mesh) -> int:
     return mesh_mod.device_count(mesh)
@@ -167,16 +175,21 @@ def assign_tilings(root: Expr) -> Expr:
         return float(e.size) * e.dtype.itemsize
 
     def best_child(c: Expr, req: Optional[Tiling]
-                   ) -> Tuple[float, Optional[Tiling]]:
+                   ) -> Tuple[float, Optional[Tiling], float]:
         best_cost = None
         best_pick = None
+        best_move = 0.0
         for tc, entry in table[c._id].items():
             move = (0.0 if req is None
                     else reshard_cost(tc, req, nbytes(c), mesh))
             total = entry[0] + move
-            if best_cost is None or total < best_cost:
-                best_cost, best_pick = total, tc
-        return best_cost or 0.0, best_pick
+            # on a total tie prefer the lower-move entry, so the move
+            # fed into the _OP_MOVE_EPS tie-break is itself
+            # deterministic (not dict-iteration-order dependent)
+            if (best_cost is None or total < best_cost
+                    or (total == best_cost and move < best_move)):
+                best_cost, best_pick, best_move = total, tc, move
+        return best_cost or 0.0, best_pick, best_move
 
     def build(node: Expr) -> None:
         if node._id in table:
@@ -204,8 +217,8 @@ def assign_tilings(root: Expr) -> Expr:
                 m_r, m_c = t.axes[0], t.axes[1]
                 best = None
                 for s in _dot_strategies(t, mesh):
-                    ca, pa = best_child(kids[0], Tiling((m_r, s)))
-                    cb, pb = best_child(kids[1], Tiling((s, m_c)))
+                    ca, pa, ma = best_child(kids[0], Tiling((m_r, s)))
+                    cb, pb, mb = best_child(kids[1], Tiling((s, m_c)))
                     psum = 0.0
                     if s is not None:
                         ns = _axis_size(mesh, s)
@@ -213,7 +226,11 @@ def assign_tilings(root: Expr) -> Expr:
                     flops = (nbytes(node) * _COMPUTE_WEIGHT
                              / (_parallelism(t, mesh)
                                 * _axis_size(mesh, s)))
-                    tot = ca + cb + psum + flops
+                    # epsilon-weighted operand movement: breaks exact
+                    # byte ties toward plans that leave operands in
+                    # place (the psum strategy) — see _OP_MOVE_EPS
+                    tot = (ca + cb + psum + flops
+                           + (ma + mb) * _OP_MOVE_EPS)
                     if best is None or tot < best[0]:
                         best = (tot, (pa, pb), s)
                 entries[t] = (best[0], best[1], best[2])
@@ -222,7 +239,7 @@ def assign_tilings(root: Expr) -> Expr:
             picks: List[Tiling] = []
             for i, c in enumerate(kids):
                 req = _operand_requirement(node, t, c, i)
-                ccost, pick = best_child(c, req)
+                ccost, pick, _ = best_child(c, req)
                 comm += ccost
                 picks.append(pick)
             entries[t] = (comm + compute, tuple(picks), None)
@@ -237,18 +254,29 @@ def assign_tilings(root: Expr) -> Expr:
         # Forcing every intermediate (e.g. a transpose) pins layouts XLA
         # would otherwise optimize through — measured 25% slower and 2x
         # the collectives on the dot-T-dot chain (benchmarks/tiling_ab).
-        # A plan equal to the node's natural behavior is also skipped: a
+        # A plan equal to the node's natural behavior is skipped: a
         # redundant with_sharding_constraint is not free, it steers
-        # XLA's propagation pass into worse solutions.
+        # XLA's propagation pass into worse solutions. 2-D GEMMs get
+        # their searched plan recorded on a SEPARATE attribute
+        # (``_dot_plan`` — operand placement, consumed by
+        # DotExpr._lower) so the plan always reaches the lowering
+        # without forcing a redundant *output* constraint when the
+        # chosen grid equals the default.
         strategy = entry[2] if entry is not None else None
         is_gemm = isinstance(node, (DotExpr, DotShardMapExpr))
+        plans_operands = (isinstance(node, DotExpr)
+                          and node.a.ndim == 2 and node.b.ndim == 2)
         nondefault = t is not None and t != node._default_tiling()
-        if node._forced_tiling is None and (
-                (force and nondefault)
-                or (is_gemm and (nondefault or strategy is not None))):
+        if plans_operands:
+            # first visit wins (diamond DAGs); the forced output — when
+            # non-default — always matches the recorded operand plan
+            if entry is not None and node._dot_plan is None:
+                node._dot_plan = (t, strategy)
+                if nondefault and node._forced_tiling is None:
+                    node._forced_tiling = t
+        elif node._forced_tiling is None and (
+                (force and nondefault) or (is_gemm and nondefault)):
             node._forced_tiling = t
-            if is_gemm:
-                node._dot_strategy = strategy
         if entry is None:
             return
         for c, tc in zip(node.children(), entry[1]):
