@@ -159,12 +159,23 @@ class MaskedDistArray:
         return self.sum(axis) / self.count(axis)
 
     def var(self, axis=None) -> Expr:
-        if axis is not None:
-            raise NotImplementedError(
-                "masked var: only full reduction (axis=None) supported")
-        d = self.filled(0) - self.mean(axis)
+        """Masked variance (``numpy.ma`` semantics, ddof=0). Per-axis:
+        the mean is computed with ``keepdims`` so it broadcasts back
+        over the reduced axis; masked positions are zeroed before the
+        square-sum so a bad mean in a fully-masked slice cannot leak
+        (those slices come out NaN — the Expr-level analogue of
+        numpy.ma's masked result, matching ``mean``'s convention)."""
+        if axis is None:
+            d = self.filled(0) - self.mean(None)
+            sq = bi.where(self.mask, 0.0, d * d)
+            return _rsum(sq, axis=None) / self.count(None)
+        valid = bi.where(self.mask, 0, 1)
+        cnt_k = _rsum(valid, axis=axis, keepdims=True)
+        mean_k = (_rsum(self.filled(0), axis=axis, keepdims=True)
+                  / bi.maximum(cnt_k, 1))
+        d = self.data - mean_k
         sq = bi.where(self.mask, 0.0, d * d)
-        return _rsum(sq, axis=None) / self.count(None)
+        return _rsum(sq, axis=axis) / self.count(axis)
 
     def std(self, axis=None) -> Expr:
         return bi.sqrt(self.var(axis))

@@ -283,11 +283,55 @@ def size(x) -> int:
     return as_expr(x).size
 
 
+class SampleSortExpr(Expr):
+    """Distributed 1-D sample sort (SURVEY.md §2.3 misc ops: the
+    reference's sampling-based distributed sort). Lowers to the
+    static-shape shard_map program in ``ops/sort.py``: local sort,
+    gathered splitter samples, all_to_all bucket exchange, local
+    merge, all_to_all rebalance to even row shards."""
+
+    def __init__(self, x: Expr):
+        self.x = x
+        super().__init__(x.shape, x.dtype)
+
+    def children(self):
+        return (self.x,)
+
+    def replace_children(self, new_children) -> "SampleSortExpr":
+        return SampleSortExpr(new_children[0])
+
+    def _lower(self, env) -> Any:
+        from ..ops import sort as sort_ops
+
+        return sort_ops.sample_sort(self.x.lower(env))
+
+    def _sig(self, ctx):
+        return ("sample_sort", ctx.of(self.x))
+
+    def _default_tiling(self):
+        from ..array import tiling as tiling_mod
+
+        return tiling_mod.row(1)
+
+
 def sort(x, axis: int = -1) -> Expr:
-    """Sorted copy along an axis. XLA lowers the sort (bitonic on TPU);
-    the reference's sampling-based distributed sort becomes a single
-    traced op over the sharded operand."""
-    return map_expr(lambda v: jnp.sort(v, axis=axis), as_expr(x))
+    """Sorted copy along an axis.
+
+    1-D arrays on a multi-device mesh (with the row axis dividing n)
+    run the distributed sample sort — splitter sampling + all_to_all
+    bucket exchange under shard_map (ops/sort.py), the reference's
+    algorithm in collective form. Everything else is a single traced
+    ``jnp.sort`` over the sharded operand (XLA bitonic sort; fine when
+    the sort axis is unsharded)."""
+    x = as_expr(x)
+    if x.ndim == 1 and axis in (-1, 0):
+        from ..array import tiling as tiling_mod
+        from ..parallel import mesh as mesh_mod
+
+        p = int(mesh_mod.get_mesh().shape.get(tiling_mod.AXIS_ROW, 1))
+        if p > 1 and x.shape[0] % p == 0:
+            return SampleSortExpr(x)
+    return map_expr(lambda v: jnp.sort(v, axis=axis), x)
 
 
 def argsort(x, axis: int = -1) -> Expr:

@@ -154,3 +154,36 @@ def test_bool_masked_max_min():
     smb = MaskedDistArray.from_numpy(nmb)
     assert bool(smb.min().glom()) == bool(nmb.min())  # True
     assert bool(smb.max().glom()) == bool(nmb.max())  # True
+
+
+def test_var_std_per_axis(pair):
+    """Round-3 verdict Missing #5: per-axis masked var/std vs numpy.ma
+    (valid slices exact; fully-masked slices NaN where ma masks)."""
+    nma, sma = pair
+    for axis in (0, 1):
+        for ours_e, ref_ma in ((sma.var(axis), nma.var(axis)),
+                               (sma.std(axis), nma.std(axis))):
+            ours = np.asarray(ours_e.glom())
+            ref = np.ma.filled(ref_ma.astype(np.float64), np.nan)
+            np.testing.assert_allclose(ours, ref, rtol=1e-4,
+                                       equal_nan=True)
+
+
+def test_var_std_fully_masked_slice(mesh2d):
+    """A fully-masked column: its per-axis var is NaN (the masked
+    result), other columns stay exact."""
+    rng = np.random.RandomState(9)
+    data = rng.rand(8, 4).astype(np.float32)
+    mask = np.zeros((8, 4), bool)
+    mask[:, 2] = True  # column 2 fully masked
+    mask[0, 0] = True  # partial masking elsewhere
+    sma = MaskedDistArray(data, mask)
+    nma = np.ma.masked_array(data, mask)
+    got = np.asarray(sma.var(axis=0).glom())
+    ref = np.ma.filled(nma.var(axis=0).astype(np.float64), np.nan)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, equal_nan=True)
+    assert np.isnan(got[2])
+    got_std = np.asarray(sma.std(axis=1).glom())
+    ref_std = np.ma.filled(nma.std(axis=1).astype(np.float64), np.nan)
+    np.testing.assert_allclose(got_std, ref_std, rtol=1e-4,
+                               equal_nan=True)

@@ -1,0 +1,90 @@
+"""Distributed 1-D sample sort vs the NumPy oracle (SURVEY.md §2.3
+misc ops: the reference's sampling-based distributed sort; round-3
+verdict Missing #2). Exercises the full collective pipeline — splitter
+sampling, all_to_all bucket exchange, local merge, rebalance — on the
+8-virtual-device mesh, including heavy skew (the case splitter
+sampling exists for)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+from spartan_tpu.expr.builtins import SampleSortExpr
+from spartan_tpu.parallel import mesh as mesh_mod
+
+
+def test_sample_sort_oracle_1m(mesh1d):
+    rng = np.random.RandomState(0)
+    a = rng.rand(1_048_576).astype(np.float32)
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(1)))
+    assert isinstance(e, SampleSortExpr)
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sample_sort_skewed(mesh1d):
+    """Zipf-ish skew + heavy duplication: most elements land in few
+    buckets — the capacity-safe exchange must still be exact."""
+    rng = np.random.RandomState(1)
+    a = np.concatenate([
+        np.zeros(40_000, np.float32),            # 40% identical
+        rng.zipf(1.5, 40_000).astype(np.float32),  # heavy tail
+        rng.rand(48_000).astype(np.float32) * 1e-3,  # dense cluster
+    ])
+    rng.shuffle(a)
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(1)))
+    assert isinstance(e, SampleSortExpr)
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sample_sort_int_dtype(mesh1d):
+    rng = np.random.RandomState(2)
+    a = rng.randint(-1000, 1000, size=64_000).astype(np.int32)
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(1)))
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sample_sort_output_sharded(mesh1d):
+    """The result stays row-sharded — no device holds the full array."""
+    rng = np.random.RandomState(3)
+    a = rng.rand(8192).astype(np.float32)
+    out = st.sort(st.from_numpy(a, tiling=tiling.row(1))).evaluate()
+    shards = out.jax_array.addressable_shards
+    assert len({s.device for s in shards}) == 8
+    assert all(s.data.shape == (1024,) for s in shards)
+
+
+def test_sample_sort_2d_mesh(mesh2d):
+    """On the 4x2 mesh the row axis (4 devices) carries the sort."""
+    rng = np.random.RandomState(4)
+    a = rng.rand(32_768).astype(np.float32)
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(1)))
+    assert isinstance(e, SampleSortExpr)
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sort_non_divisible_falls_back(mesh1d):
+    """n % p != 0: the traced jnp.sort path, still oracle-exact."""
+    rng = np.random.RandomState(5)
+    a = rng.rand(1001).astype(np.float32)
+    e = st.sort(st.from_numpy(a))
+    assert not isinstance(e, SampleSortExpr)
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sort_2d_axis_unchanged(mesh1d):
+    """ndim > 1 keeps the traced per-axis sort."""
+    rng = np.random.RandomState(6)
+    a = rng.rand(16, 8).astype(np.float32)
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(2)), axis=1)
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a, axis=1))
+
+
+def test_sample_sort_inf_values(mesh1d):
+    """Data containing +/-inf must not collide with exchange padding."""
+    rng = np.random.RandomState(7)
+    a = rng.rand(4096).astype(np.float32)
+    a[::100] = np.inf
+    a[::173] = -np.inf
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(1)))
+    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))

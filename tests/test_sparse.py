@@ -323,3 +323,66 @@ def test_pagerank_multichip(mesh1d):
     assert ranks.argmax() == 0
     assert ranks[1] > ranks[2]
     np.testing.assert_allclose(ranks.sum(), 1.0, rtol=1e-3)
+
+
+def test_transpose_no_host_roundtrip(monkeypatch):
+    """Round-3 verdict Weak #4 done-criterion: transpose() performs no
+    device_get — the re-sort runs entirely on device."""
+    dense = _random_sparse(24, 16, seed=11)
+    sp = SparseDistArray.from_dense(dense)
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting_get(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    spt = sp.transpose()
+    monkeypatch.undo()
+    assert calls["n"] == 0, f"transpose did {calls['n']} device_gets"
+    np.testing.assert_allclose(spt.glom(), dense.T, rtol=1e-6)
+
+
+def test_transpose_scipy_oracle_padding_and_claims():
+    """Transpose of a padded matrix: entries stay (row, col)-sorted,
+    unique, with padding out of range — and match scipy exactly."""
+    import scipy.sparse as ss
+
+    rng = np.random.RandomState(12)
+    n, m = 30, 17
+    nnz = 60
+    r = rng.randint(0, n, nnz)
+    c = rng.randint(0, m, nnz)
+    v = rng.rand(nnz).astype(np.float32)
+    sp = SparseDistArray.from_coo(r, c, v, (n, m), pad_to=128)
+    spt = sp.transpose()
+    oracle = ss.coo_matrix((v, (r, c)), shape=(n, m)).toarray().T
+    np.testing.assert_allclose(spt.glom(), oracle, rtol=1e-6)
+    rows = np.asarray(jax.device_get(spt.rows)).astype(np.int64)
+    cols = np.asarray(jax.device_get(spt.cols)).astype(np.int64)
+    flat = rows * n + cols
+    assert (np.diff(flat) > 0).all(), "entries not strictly sorted"
+    assert (rows[spt.nnz:] >= m).all(), "padding rows in range"
+    # double transpose round-trips
+    np.testing.assert_allclose(spt.transpose().glom(),
+                               oracle.T, rtol=1e-6)
+
+
+def test_mesh_fn_cache_bounded():
+    """Round-3 verdict Weak #6: equivalent transient meshes share one
+    compiled-executable cache entry instead of accumulating."""
+    from spartan_tpu.array import sparse as sparse_mod
+    from spartan_tpu.parallel import mesh as mesh_mod
+
+    dense = _random_sparse(16, 16, seed=13)
+    before = len(sparse_mod._sharded_spmv_fn)
+    x = np.ones(16, np.float32)
+    for _ in range(12):  # fresh equivalent Mesh each iteration
+        m = mesh_mod.build_mesh(jax.devices(), shape=(8, 1))
+        with mesh_mod.use_mesh(m):
+            sp = SparseDistArray.from_dense(dense, mesh=m)
+            sp.spmv(x, impl="sharded")
+    after = len(sparse_mod._sharded_spmv_fn)
+    assert after - before <= 1, \
+        f"cache grew by {after - before} for equivalent meshes"
