@@ -123,25 +123,33 @@ def _mesh_key(mesh) -> Tuple:
 
 
 class _MeshFnCache:
-    """Tiny LRU keyed on :func:`_mesh_key` + extra args."""
+    """Tiny thread-safe LRU keyed on :func:`_mesh_key` + extra args."""
 
     def __init__(self, build, maxsize: int = 64):
+        import threading
+
         self._build = build
         self._maxsize = maxsize
         self._entries: dict = {}
+        self._lock = threading.Lock()
 
     def __call__(self, mesh, *args):
         key = (_mesh_key(mesh),) + args
-        fn = self._entries.pop(key, None)
-        if fn is None:
-            fn = self._build(mesh, *args)
-        self._entries[key] = fn  # re-insert: move-to-end LRU
-        while len(self._entries) > self._maxsize:
-            self._entries.pop(next(iter(self._entries)))
+        with self._lock:
+            fn = self._entries.pop(key, None)
+            if fn is not None:
+                self._entries[key] = fn  # re-insert: move-to-end LRU
+                return fn
+        fn = self._build(mesh, *args)  # compile outside the lock
+        with self._lock:
+            fn = self._entries.setdefault(key, fn)  # first build wins
+            while len(self._entries) > self._maxsize:
+                self._entries.pop(next(iter(self._entries)))
         return fn
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
 
 def _build_sharded_spmv(mesh, n, x_ndim):

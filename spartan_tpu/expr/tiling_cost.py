@@ -34,14 +34,28 @@ from .reduce import GeneralReduceExpr, ReduceExpr
 from .reshape import TransposeExpr
 from .slice import SliceExpr
 
-_COMPUTE_WEIGHT = 4.0  # bytes-equivalent per element of local compute
+# Bytes-equivalent weight of local compute relative to interconnect
+# bytes. The default was CALIBRATED on the 8-virtual-device CPU mesh
+# (benchmarks/tiling_ab.py --sweep runs calibrate_compute_weight and
+# records the measurement in benchmarks/tiling_sweep.json); override
+# per-platform with --tiling_compute_weight.
+_COMPUTE_WEIGHT = 4.0
 
-# Tie-break weight for operand-reshard bytes in GEMM plans. On square
-# meshes a contraction-sharded plan (output psum, operands in place)
-# can tie a gathered plan byte-for-byte; physically the psum plan wins
-# — operand gathers sit on the critical path before the MXU and
-# replicate operand memory, while the output all-reduce pipelines with
-# the epilogue. Small enough to never override a real byte difference.
+# Weight on operand-reshard bytes in GEMM plans, relative to output
+# psum bytes. Operand gathers sit on the critical path BEFORE the
+# matmul and replicate operand memory, while the output all-reduce
+# pipelines with the epilogue — so a byte of operand movement costs
+# more wall time than a byte of psum. CALIBRATED by the measured-arm
+# sweep (benchmarks/tiling_ab.py --sweep, 8 layout combos x all
+# candidate plans on the 8-device CPU mesh): with weight 1 the model
+# picked gathered plans measuring up to 2.2x slower than the best
+# psum arm (col x row combo); weight 2 ranks every combo's pick
+# within the 20%-of-best bound (tiling_sweep.json). Override with
+# --tiling_operand_move_weight.
+_OPERAND_MOVE_WEIGHT = 2.0
+
+# Tie-break epsilon on the same quantity: keeps plan choice
+# deterministic on exact byte ties regardless of the weight above.
 _OP_MOVE_EPS = 2.0 ** -20
 
 
@@ -78,6 +92,13 @@ def candidates(node: Expr, mesh) -> List[Tiling]:
     for t in cands:
         if tiling_mod.sanitize(t, node.shape, mesh) == t:
             out.append(t)
+    # Deterministic order, row-sharded outputs first: exact cost ties
+    # resolve to the earlier candidate, and sharding axis 0 wins ties
+    # (XLA's row-major layouts make row-sharded outputs cheaper than
+    # the cost-equivalent col-sharded ones — measured in the --sweep).
+    out.sort(key=lambda t: (not t.axes or t.axes[0] is None,
+                            tuple(a is None for a in t.axes),
+                            str(t.axes)))
     return out or [tiling_mod.replicated(nd)]
 
 
@@ -160,29 +181,47 @@ def _dot_strategies(t: Tiling, mesh) -> List[Optional[str]]:
     return out
 
 
-def assign_tilings(root: Expr) -> Expr:
-    from .dot import DotExpr, DotShardMapExpr
+def _compute_weight() -> float:
+    from ..utils.config import FLAGS
 
-    mesh = mesh_mod.get_mesh()
-    if _mesh_n(mesh) <= 1:
-        return root  # single device: everything is replicated anyway
+    w = float(getattr(FLAGS, "tiling_compute_weight", 0.0) or 0.0)
+    return w if w > 0 else _COMPUTE_WEIGHT
 
-    # cost_table[node_id][tiling] = (cost, per-child picks, extra)
-    # where extra is the chosen contraction strategy for GEMM nodes
+
+def _operand_move_weight() -> float:
+    from ..utils.config import FLAGS
+
+    w = float(getattr(FLAGS, "tiling_operand_move_weight", 0.0) or 0.0)
+    return w if w > 0 else _OPERAND_MOVE_WEIGHT
+
+
+def _build_table(root: Expr, mesh) -> Dict:
+    """Bottom-up candidate cost table:
+    ``table[node_id][tiling] = (cost, per-child picks, strategy)``
+    where strategy is the chosen contraction placement for GEMMs."""
+    from .dot import DotExpr
+
     table: Dict[int, Dict[Tiling, Tuple[float, Tuple, Optional[str]]]] = {}
+    weight = _compute_weight()
+    move_w = _operand_move_weight()
 
     def nbytes(e: Expr) -> float:
         return float(e.size) * e.dtype.itemsize
 
-    def best_child(c: Expr, req: Optional[Tiling]
+    def best_child(c: Expr, req: Optional[Tiling], w: float = 1.0
                    ) -> Tuple[float, Optional[Tiling], float]:
+        """Cheapest child entry under requirement ``req``, with the
+        reshard move charged at weight ``w`` (GEMM operand moves use
+        _OPERAND_MOVE_WEIGHT so selection and plan pricing agree —
+        otherwise a reshard-heavy child could win selection at weight
+        1 and then be priced at w). Returns (total, pick, move)."""
         best_cost = None
         best_pick = None
         best_move = 0.0
         for tc, entry in table[c._id].items():
             move = (0.0 if req is None
                     else reshard_cost(tc, req, nbytes(c), mesh))
-            total = entry[0] + move
+            total = entry[0] + w * move
             # on a total tie prefer the lower-move entry, so the move
             # fed into the _OP_MOVE_EPS tie-break is itself
             # deterministic (not dict-iteration-order dependent)
@@ -205,7 +244,7 @@ def assign_tilings(root: Expr) -> Expr:
         is_gemm = (isinstance(node, DotExpr)
                    and node.a.ndim == 2 and node.b.ndim == 2)
         for t in candidates(node, mesh):
-            compute = (nbytes(node) * _COMPUTE_WEIGHT
+            compute = (nbytes(node) * weight
                        / _parallelism(t, mesh))
             if is_gemm:
                 # search contraction strategies: operand layouts are
@@ -217,18 +256,21 @@ def assign_tilings(root: Expr) -> Expr:
                 m_r, m_c = t.axes[0], t.axes[1]
                 best = None
                 for s in _dot_strategies(t, mesh):
-                    ca, pa, ma = best_child(kids[0], Tiling((m_r, s)))
-                    cb, pb, mb = best_child(kids[1], Tiling((s, m_c)))
+                    ca, pa, ma = best_child(kids[0], Tiling((m_r, s)),
+                                            move_w)
+                    cb, pb, mb = best_child(kids[1], Tiling((s, m_c)),
+                                            move_w)
                     psum = 0.0
                     if s is not None:
                         ns = _axis_size(mesh, s)
                         psum = nbytes(node) * (ns - 1) / ns
-                    flops = (nbytes(node) * _COMPUTE_WEIGHT
+                    flops = (nbytes(node) * weight
                              / (_parallelism(t, mesh)
                                 * _axis_size(mesh, s)))
-                    # epsilon-weighted operand movement: breaks exact
-                    # byte ties toward plans that leave operands in
-                    # place (the psum strategy) — see _OP_MOVE_EPS
+                    # operand movement is charged at move_w inside
+                    # best_child (critical path before the matmul —
+                    # see _OPERAND_MOVE_WEIGHT); the epsilon keeps
+                    # exact ties deterministic
                     tot = (ca + cb + psum + flops
                            + (ma + mb) * _OP_MOVE_EPS)
                     if best is None or tot < best[0]:
@@ -244,6 +286,20 @@ def assign_tilings(root: Expr) -> Expr:
                 picks.append(pick)
             entries[t] = (comm + compute, tuple(picks), None)
         table[node._id] = entries
+
+    roots = root.elements if isinstance(root, TupleExpr) else (root,)
+    for r in roots:
+        build(r)
+    return table
+
+
+def assign_tilings(root: Expr) -> Expr:
+    from .dot import DotExpr, DotShardMapExpr
+
+    mesh = mesh_mod.get_mesh()
+    if _mesh_n(mesh) <= 1:
+        return root  # single device: everything is replicated anyway
+    table = _build_table(root, mesh)
 
     def commit(node: Expr, t: Tiling, force: bool) -> None:
         if isinstance(node, (ValExpr, ScalarExpr)):
@@ -285,10 +341,75 @@ def assign_tilings(root: Expr) -> Expr:
 
     roots = root.elements if isinstance(root, TupleExpr) else (root,)
     for r in roots:
-        build(r)
         best_t = min(table[r._id], key=lambda t: table[r._id][t][0])
         commit(r, best_t, True)
     return root
+
+
+def gemm_plan_costs(root: Expr) -> Dict:
+    """Candidate ``(output tiling, strategy, model cost)`` lists for
+    every 2-D GEMM node in ``root`` — the validation surface for the
+    cost model (benchmarks/tiling_ab.py --sweep and
+    tests/test_tiling_calibration.py force each candidate as a
+    measured arm and compare the model's ranking against wall time).
+    Returns ``{DotExpr node: [(Tiling, strategy, cost), ...]}``."""
+    from .dot import DotExpr
+    from .optimize import dag_nodes
+
+    mesh = mesh_mod.get_mesh()
+    if _mesh_n(mesh) <= 1:
+        return {}
+    table = _build_table(root, mesh)
+    out = {}
+    for n in dag_nodes(root):
+        if (isinstance(n, DotExpr) and n.a.ndim == 2 and n.b.ndim == 2
+                and n._id in table):
+            out[n] = sorted(
+                ((t, e[2], e[0]) for t, e in table[n._id].items()),
+                key=lambda x: x[2])
+    return out
+
+
+def calibrate_compute_weight(n: int = 512, iters: int = 5,
+                             mesh=None) -> float:
+    """Measure the compute weight on the current backend.
+
+    The model prices a replicated GEMM's compute at ``nbytes * C`` and
+    a full all-gather at ``nbytes * (p-1)/p``; calibrating C so those
+    two ratios match the measured single-device matmul time vs the
+    measured all-gather time makes the model's compute/communication
+    trade-off empirical instead of guessed:
+    ``C = (t_matmul / t_allgather) * (p - 1) / p``.
+    Record per-platform values via ``--tiling_compute_weight``."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    mesh = mesh or mesh_mod.get_mesh()
+    p = _mesh_n(mesh)
+    if p <= 1:
+        return _COMPUTE_WEIGHT
+    x = jnp.asarray(np.random.RandomState(0).rand(n, n).astype(np.float32))
+    mm = jax.jit(lambda a: a @ a)
+    jax.block_until_ready(mm(x))
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(mm(x))
+    t_mm = (_time.perf_counter() - t0) / iters
+
+    row = tiling_mod.row(2)
+    rep = tiling_mod.replicated(2)
+    xs = jax.device_put(x, row.sharding(mesh))
+    gather = jax.jit(lambda a: a, out_shardings=rep.sharding(mesh))
+    jax.block_until_ready(gather(xs))
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(gather(xs))
+    t_ag = (_time.perf_counter() - t0) / iters
+    if t_ag <= 0:
+        return _COMPUTE_WEIGHT
+    return float(t_mm / t_ag * (p - 1) / p)
 
 
 def explain(root: Expr) -> str:

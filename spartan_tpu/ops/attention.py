@@ -130,13 +130,15 @@ def ring_attention(q, k, v, causal: bool = False,
     def kernel(ql, kl, vl):
         my = lax.axis_index(mesh_axis)
         q_off = my * shard_l
-        # pvary: these carries become device-varying once the ring runs,
-        # so the initial values must be marked varying too
-        acc = lax.pvary(jnp.zeros(ql.shape, jnp.float32), (mesh_axis,))
-        m = lax.pvary(jnp.full((ql.shape[1], ql.shape[0]), _NEG_INF,
-                               jnp.float32), (mesh_axis,))
-        den = lax.pvary(jnp.zeros((ql.shape[1], ql.shape[0]), jnp.float32),
-                        (mesh_axis,))
+        # pcast-to-varying: these carries become device-varying once
+        # the ring runs, so the initial values must be marked varying
+        # too (pvary was deprecated in favor of pcast)
+        acc = lax.pcast(jnp.zeros(ql.shape, jnp.float32), (mesh_axis,),
+                        to="varying")
+        m = lax.pcast(jnp.full((ql.shape[1], ql.shape[0]), _NEG_INF,
+                               jnp.float32), (mesh_axis,), to="varying")
+        den = lax.pcast(jnp.zeros((ql.shape[1], ql.shape[0]), jnp.float32),
+                        (mesh_axis,), to="varying")
 
         def body(s, carry):
             acc, m, den, kk, vv = carry

@@ -151,6 +151,15 @@ FLAGS.define_bool("opt_collapse_cached", True,
                   "Collapse already-evaluated sub-DAGs into leaves.")
 FLAGS.define_bool("opt_auto_tiling", True,
                   "Smart-tiling pass: pick shardings via the cost model.")
+FLAGS.define_float(
+    "tiling_compute_weight", 0.0,
+    "Compute-vs-communication weight for the smart-tiling cost model "
+    "(0 = built-in default; calibrate with "
+    "tiling_cost.calibrate_compute_weight).")
+FLAGS.define_float(
+    "tiling_operand_move_weight", 0.0,
+    "Weight on GEMM operand-reshard bytes vs output-psum bytes in the "
+    "smart-tiling cost model (0 = built-in calibrated default).")
 FLAGS.define_bool("opt_fold_slices", True,
                   "Fold slice-of-slice and slice-of-map expressions.")
 FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
