@@ -44,9 +44,13 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
 
 def initialize(argv=None):
     """Parity with the reference's ``spartan.initialize()`` (SURVEY.md
-    §3.1): parse flags and install the ambient context. The whole
-    master/worker bring-up collapses to mesh construction."""
+    §3.1): parse flags, bring up the multi-host control plane when a
+    cluster environment is present (``jax.distributed`` plays the
+    reference master's registration/barrier role — SURVEY.md §2.7;
+    no-op standalone), and install the ambient mesh. The whole
+    master/worker bring-up otherwise collapses to mesh construction."""
     rest = FLAGS.parse_args(argv)
+    _mesh.initialize_distributed()  # no-op unless COORDINATOR/SLURM env
     _mesh.get_mesh()
     return rest
 

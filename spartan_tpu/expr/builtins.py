@@ -288,30 +288,45 @@ class SampleSortExpr(Expr):
     reference's sampling-based distributed sort). Lowers to the
     static-shape shard_map program in ``ops/sort.py``: local sort,
     gathered splitter samples, all_to_all bucket exchange, local
-    merge, all_to_all rebalance to even row shards."""
+    merge, all_to_all rebalance to even row shards. With
+    ``indices=True`` it is the distributed argsort (global source
+    indices ride the pipeline as a sort payload)."""
 
-    def __init__(self, x: Expr):
+    def __init__(self, x: Expr, indices: bool = False):
         self.x = x
-        super().__init__(x.shape, x.dtype)
+        self.indices = indices
+        super().__init__(x.shape, np.int32 if indices else x.dtype)
 
     def children(self):
         return (self.x,)
 
     def replace_children(self, new_children) -> "SampleSortExpr":
-        return SampleSortExpr(new_children[0])
+        return SampleSortExpr(new_children[0], self.indices)
 
     def _lower(self, env) -> Any:
         from ..ops import sort as sort_ops
 
-        return sort_ops.sample_sort(self.x.lower(env))
+        fn = (sort_ops.sample_argsort if self.indices
+              else sort_ops.sample_sort)
+        return fn(self.x.lower(env))
 
     def _sig(self, ctx):
-        return ("sample_sort", ctx.of(self.x))
+        return ("sample_sort", self.indices, ctx.of(self.x))
 
     def _default_tiling(self):
         from ..array import tiling as tiling_mod
 
         return tiling_mod.row(1)
+
+
+def _distributed_sortable(x: Expr, axis: int) -> bool:
+    from ..array import tiling as tiling_mod
+    from ..parallel import mesh as mesh_mod
+
+    if x.ndim != 1 or axis not in (-1, 0):
+        return False
+    p = int(mesh_mod.get_mesh().shape.get(tiling_mod.AXIS_ROW, 1))
+    return p > 1 and x.shape[0] % p == 0
 
 
 def sort(x, axis: int = -1) -> Expr:
@@ -324,18 +339,18 @@ def sort(x, axis: int = -1) -> Expr:
     ``jnp.sort`` over the sharded operand (XLA bitonic sort; fine when
     the sort axis is unsharded)."""
     x = as_expr(x)
-    if x.ndim == 1 and axis in (-1, 0):
-        from ..array import tiling as tiling_mod
-        from ..parallel import mesh as mesh_mod
-
-        p = int(mesh_mod.get_mesh().shape.get(tiling_mod.AXIS_ROW, 1))
-        if p > 1 and x.shape[0] % p == 0:
-            return SampleSortExpr(x)
+    if _distributed_sortable(x, axis):
+        return SampleSortExpr(x)
     return map_expr(lambda v: jnp.sort(v, axis=axis), x)
 
 
 def argsort(x, axis: int = -1) -> Expr:
-    return map_expr(lambda v: jnp.argsort(v, axis=axis), as_expr(x))
+    """Indices that sort ``x``; 1-D multi-device arrays run the
+    distributed sample argsort (see :func:`sort`)."""
+    x = as_expr(x)
+    if _distributed_sortable(x, axis):
+        return SampleSortExpr(x, indices=True)
+    return map_expr(lambda v: jnp.argsort(v, axis=axis), x)
 
 
 def median(x, axis=None) -> Expr:

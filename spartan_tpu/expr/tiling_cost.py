@@ -35,10 +35,13 @@ from .reshape import TransposeExpr
 from .slice import SliceExpr
 
 # Bytes-equivalent weight of local compute relative to interconnect
-# bytes. The default was CALIBRATED on the 8-virtual-device CPU mesh
-# (benchmarks/tiling_ab.py --sweep runs calibrate_compute_weight and
-# records the measurement in benchmarks/tiling_sweep.json); override
-# per-platform with --tiling_compute_weight.
+# bytes. 4.0 is a HAND-CHOSEN default: the CPU-mesh measurement
+# (calibrate_compute_weight, recorded as ~0.9 in
+# benchmarks/tiling_sweep.json) produced worse plan picks when applied
+# directly — the model's compute term scales with output bytes, not
+# FLOPs, so the measured ratio at one shape does not transfer. Override
+# per-platform with --tiling_compute_weight after validating with the
+# --sweep.
 _COMPUTE_WEIGHT = 4.0
 
 # Weight on operand-reshard bytes in GEMM plans, relative to output
@@ -49,9 +52,10 @@ _COMPUTE_WEIGHT = 4.0
 # sweep (benchmarks/tiling_ab.py --sweep, 8 layout combos x all
 # candidate plans on the 8-device CPU mesh): with weight 1 the model
 # picked gathered plans measuring up to 2.2x slower than the best
-# psum arm (col x row combo); weight 2 ranks every combo's pick
-# within the 20%-of-best bound (tiling_sweep.json). Override with
-# --tiling_operand_move_weight.
+# psum arm (col x row combo); weight 2 brings every combo's pick
+# within 20% of the best measured arm EXCEPT row_t x row_t (1.25x —
+# the known residual documented in tiling_sweep.json's notes).
+# Override with --tiling_operand_move_weight.
 _OPERAND_MOVE_WEIGHT = 2.0
 
 # Tie-break epsilon on the same quantity: keeps plan choice

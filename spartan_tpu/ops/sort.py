@@ -43,10 +43,22 @@ from ..parallel import mesh as mesh_mod
 _SAMPLES = 64  # per-shard splitter samples (capped at shard size)
 
 
-def _kernel(xs: jax.Array, axis, p: int, s: int) -> jax.Array:
+def _kernel(xs: jax.Array, axis, p: int, s: int,
+            with_indices: bool = False):
+    """One shard's sample sort; with ``with_indices`` the element's
+    GLOBAL source index rides the whole pipeline as a sort payload and
+    the function returns ``(values, indices)`` — the distributed
+    argsort."""
     m = xs.shape[0]
     dt = xs.dtype
-    xs_sorted = jnp.sort(xs)
+    me = jax.lax.axis_index(axis)
+    if with_indices:
+        order = jnp.argsort(xs).astype(jnp.int32)
+        xs_sorted = xs[order]
+        src_idx = me.astype(jnp.int32) * m + order     # global indices
+    else:  # plain sort: cheaper than argsort + gather
+        xs_sorted = jnp.sort(xs)
+        src_idx = None
 
     # -- splitters ------------------------------------------------------
     samp_idx = (jnp.arange(s) * m) // s
@@ -54,47 +66,55 @@ def _kernel(xs: jax.Array, axis, p: int, s: int) -> jax.Array:
     alls = jnp.sort(jax.lax.all_gather(samples, axis, tiled=True))
     splitters = alls[jnp.arange(1, p) * s]             # (p-1,)
 
+    def exchange(mat):
+        return jax.lax.all_to_all(mat, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+
     # -- bucket exchange (static capacity m per destination) ------------
     dst = jnp.searchsorted(splitters, xs_sorted,
                            side="right").astype(jnp.int32)
     counts = jnp.bincount(dst, length=p)
     starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
     pos = jnp.arange(m, dtype=jnp.int32) - starts[dst]
-    send = jnp.zeros((p, m), dt).at[dst, pos].set(xs_sorted)
-    valid = jnp.zeros((p, m), jnp.int32).at[dst, pos].set(1)
-    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
-                              tiled=True)
-    rvalid = jax.lax.all_to_all(valid, axis, split_axis=0, concat_axis=0,
-                                tiled=True)
+    recv = exchange(jnp.zeros((p, m), dt).at[dst, pos].set(xs_sorted))
+    rvalid = exchange(jnp.zeros((p, m), jnp.int32).at[dst, pos].set(1))
+    ridx = exchange(jnp.zeros((p, m), jnp.int32)
+                    .at[dst, pos].set(src_idx)) if with_indices else None
 
     # -- local merge: (invalid, value) two-key sort keeps padding last
-    # even when the data itself contains +inf ---------------------------
+    # even when the data itself contains +inf; indices ride as payload -
     pad_key = (1 - rvalid).ravel()
-    _, bucket, = jax.lax.sort((pad_key, recv.ravel()), num_keys=2)
+    if with_indices:
+        _, bucket, bidx = jax.lax.sort(
+            (pad_key, recv.ravel(), ridx.ravel()), num_keys=2)
+    else:
+        _, bucket = jax.lax.sort((pad_key, recv.ravel()), num_keys=2)
+        bidx = None
     k = jnp.sum(rvalid)                                # my bucket size
 
     # -- rebalance to even output shards --------------------------------
     ks = jax.lax.all_gather(k[None], axis, tiled=True)  # (p,)
-    me = jax.lax.axis_index(axis)
     off = (jnp.cumsum(ks) - ks)[me]                    # my global offset
     out_starts = jnp.arange(p, dtype=ks.dtype) * m
     lo = jnp.maximum(off, out_starts)
     hi = jnp.minimum(off + k, out_starts + m)
     cnt = jnp.maximum(hi - lo, 0).astype(jnp.int32)    # (p,) chunk sizes
     st = (lo - out_starts).astype(jnp.int32)           # start in dest
-    gidx = jnp.clip(lo[:, None] - off + jnp.arange(m)[None, :],
-                    0, p * m - 1).astype(jnp.int32)
-    chunks = bucket[gidx]                              # (p, m)
-    rchunks = jax.lax.all_to_all(chunks, axis, split_axis=0,
-                                 concat_axis=0, tiled=True)
-    rcnt = jax.lax.all_to_all(cnt, axis, split_axis=0, concat_axis=0,
-                              tiled=True)
-    rst = jax.lax.all_to_all(st, axis, split_axis=0, concat_axis=0,
-                             tiled=True)
+    gather_idx = jnp.clip(lo[:, None] - off + jnp.arange(m)[None, :],
+                          0, p * m - 1).astype(jnp.int32)
+    rchunks = exchange(bucket[gather_idx])             # (p, m)
+    rcnt = exchange(cnt)
+    rst = exchange(st)
     t = jnp.arange(m, dtype=jnp.int32)[None, :]
     positions = jnp.where(t < rcnt[:, None], rst[:, None] + t, m)
-    return (jnp.zeros((m,), dt)
-            .at[positions.ravel()].set(rchunks.ravel(), mode="drop"))
+    out_vals = (jnp.zeros((m,), dt)
+                .at[positions.ravel()].set(rchunks.ravel(), mode="drop"))
+    if not with_indices:
+        return out_vals
+    richunks = exchange(bidx[gather_idx])
+    out_idx = (jnp.zeros((m,), jnp.int32)
+               .at[positions.ravel()].set(richunks.ravel(), mode="drop"))
+    return out_vals, out_idx
 
 
 def sample_sort(x: jax.Array, mesh=None) -> jax.Array:
@@ -119,4 +139,25 @@ def sample_sort(x: jax.Array, mesh=None) -> jax.Array:
     s = min(_SAMPLES, n // p)
     mapped = shard_map(lambda v: _kernel(v, axis, p, s), mesh=mesh,
                        in_specs=(row.spec(),), out_specs=row.spec())
+    return mapped(x)
+
+
+def sample_argsort(x: jax.Array, mesh=None) -> jax.Array:
+    """Indices that sort a 1-D row-sharded array (distributed argsort:
+    global source indices ride the sample-sort pipeline as a sort
+    payload). Same divisibility fallback as :func:`sample_sort`."""
+    from jax import shard_map
+
+    mesh = mesh or mesh_mod.get_mesh()
+    axis = tiling_mod.AXIS_ROW
+    p = int(mesh.shape[axis])
+    n = int(x.shape[0])
+    if p <= 1 or n % p != 0:
+        return jnp.argsort(x).astype(jnp.int32)
+    row = tiling_mod.row(1)
+    x = jax.lax.with_sharding_constraint(x, row.sharding(mesh))
+    s = min(_SAMPLES, n // p)
+    mapped = shard_map(
+        lambda v: _kernel(v, axis, p, s, with_indices=True)[1],
+        mesh=mesh, in_specs=(row.spec(),), out_specs=row.spec())
     return mapped(x)

@@ -122,9 +122,13 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             jax.distributed.initialize(coordinator_address,
                                        num_processes, process_id)
             return True
-        # auto-detection (TPU pods, SLURM, ...) — raises when standalone
-        if (os.environ.get("COORDINATOR_ADDRESS")
-                or os.environ.get("SLURM_JOB_ID")):
+        # Auto-detection ONLY on an explicit coordinator address: a
+        # bare SLURM_JOB_ID must not trigger it — a single-process run
+        # inside a multi-task allocation would start the coordinator
+        # and BLOCK waiting for peers that never register. SLURM/pod
+        # users launched on every task call this with explicit args or
+        # set COORDINATOR_ADDRESS.
+        if os.environ.get("COORDINATOR_ADDRESS"):
             jax.distributed.initialize()
             return True
     except Exception as e:  # pragma: no cover - env-dependent

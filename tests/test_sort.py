@@ -88,3 +88,34 @@ def test_sample_sort_inf_values(mesh1d):
     a[::173] = -np.inf
     e = st.sort(st.from_numpy(a, tiling=tiling.row(1)))
     np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sample_argsort_oracle(mesh1d):
+    """Distributed argsort: x[perm] is sorted and perm is a true
+    permutation (np.argsort's exact tie order is not guaranteed)."""
+    rng = np.random.RandomState(8)
+    a = rng.rand(65_536).astype(np.float32)
+    e = st.argsort(st.from_numpy(a, tiling=tiling.row(1)))
+    assert isinstance(e, SampleSortExpr) and e.indices
+    perm = np.asarray(e.glom())
+    assert perm.dtype == np.int32
+    assert np.array_equal(np.sort(perm), np.arange(a.size))
+    np.testing.assert_array_equal(a[perm], np.sort(a))
+
+
+def test_sample_argsort_duplicates(mesh2d):
+    rng = np.random.RandomState(9)
+    a = rng.randint(0, 7, size=16_384).astype(np.float32)
+    e = st.argsort(st.from_numpy(a, tiling=tiling.row(1)))
+    perm = np.asarray(e.glom())
+    assert np.array_equal(np.sort(perm), np.arange(a.size))
+    np.testing.assert_array_equal(a[perm], np.sort(a))
+
+
+def test_argsort_fallback_non_divisible(mesh1d):
+    rng = np.random.RandomState(10)
+    a = rng.rand(1001).astype(np.float32)
+    e = st.argsort(st.from_numpy(a))
+    assert not isinstance(e, SampleSortExpr)
+    perm = np.asarray(e.glom())
+    np.testing.assert_array_equal(a[perm], np.sort(a))
