@@ -9,18 +9,21 @@ so users don't reach into ``examples``:
     from spartan_tpu.models import pagerank, ssvd, als
 
 Estimators follow the sklearn fit/predict convention; functional
-algorithms (pagerank, ssvd, als, cg, matrix factorization,
-decompositions) are re-exported directly.
+algorithms (pagerank, ssvd, lanczos SVD, als, cg, matrix factorization,
+decompositions, lda, lsh) are re-exported directly.
 """
 
 from ..examples.als import als  # noqa: F401
-from ..examples.conj_gradient import conjugate_gradient  # noqa: F401
-from ..examples.decomposition import (blocked_cholesky,  # noqa: F401
-                                      blocked_qr, tsqr)
+from ..examples.conj_gradient import conj_gradient as conjugate_gradient  # noqa: F401,E501
+from ..examples.decomposition import (cholesky,  # noqa: F401
+                                      netflix_sgd, qr, tsqr)
 from ..examples.fuzzy_kmeans import fuzzy_kmeans  # noqa: F401
 from ..examples.kmeans import assign_points, kmeans  # noqa: F401
+from ..examples.lanczos import lanczos_svd  # noqa: F401
+from ..examples.lda import lda  # noqa: F401
+from ..examples.lsh import candidate_pairs as lsh_candidate_pairs  # noqa: F401,E501
 from ..examples.matrix_fact import sgd_matrix_factorization  # noqa: F401
-from ..examples.naive_bayes import fit_naive_bayes  # noqa: F401
+from ..examples.naive_bayes import fit as fit_naive_bayes  # noqa: F401
 from ..examples.pagerank import pagerank  # noqa: F401
 from ..examples.regression import (linear_regression,  # noqa: F401
                                    logistic_regression, ridge_regression)
@@ -30,11 +33,12 @@ from ..examples.sklearn.linear_model import (LinearRegression,  # noqa: F401
                                              SGDSVC)
 from ..examples.sklearn.naive_bayes import MultinomialNB  # noqa: F401
 from ..examples.ssvd import ssvd  # noqa: F401
-from ..examples.svm import svm_fit  # noqa: F401
+from ..examples.svm import svm as svm_fit  # noqa: F401
 
 __all__ = [
-    "als", "conjugate_gradient", "blocked_cholesky", "blocked_qr", "tsqr",
-    "fuzzy_kmeans", "kmeans", "assign_points",
+    "als", "conjugate_gradient", "cholesky", "qr", "tsqr", "netflix_sgd",
+    "fuzzy_kmeans", "kmeans", "assign_points", "lanczos_svd", "lda",
+    "lsh_candidate_pairs",
     "sgd_matrix_factorization", "fit_naive_bayes", "pagerank",
     "linear_regression", "logistic_regression", "ridge_regression",
     "ssvd", "svm_fit",

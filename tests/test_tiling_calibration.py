@@ -69,8 +69,10 @@ def test_model_pick_within_20pct_of_best(mesh2d, ta, tb):
     a = rng.rand(n, n).astype(np.float32)
     b = rng.rand(n, n).astype(np.float32)
     pick, best = _measure_combo(a, b, ta, tb, iters=5)
-    if pick > 1.2 * best:  # one retry at higher iters: timing noise
-        pick, best = _measure_combo(a, b, ta, tb, iters=11)
+    for retry_iters in (11, 15):  # retries absorb shared-machine load
+        if pick <= 1.2 * best:
+            break
+        pick, best = _measure_combo(a, b, ta, tb, iters=retry_iters)
     assert pick <= 1.2 * best, \
         f"model pick {pick:.5f}s vs best arm {best:.5f}s"
 
