@@ -1,0 +1,102 @@
+"""Distributed blocked prefix scan vs NumPy oracle (SURVEY.md §2.3
+scan; BASELINE.json:11). The traced jnp.cumsum alternative all-gathers
+a sharded scan axis (and measured minutes at 4M elements on the CPU
+mesh), so axis-0 scans must route to the blocked shard_map program."""
+
+import numpy as np
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+from spartan_tpu.expr.builtins import BlockedScanExpr
+
+
+def test_blocked_cumsum_1d(mesh1d):
+    rng = np.random.RandomState(0)
+    a = rng.rand(1 << 20).astype(np.float32)
+    e = st.cumsum(st.from_numpy(a, tiling=tiling.row(1)))
+    assert isinstance(e, BlockedScanExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()), np.cumsum(a),
+                               rtol=1e-4)
+
+
+def test_blocked_scan_2d_axis0(mesh1d):
+    rng = np.random.RandomState(1)
+    a = rng.rand(4096, 8).astype(np.float32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.row(2)), axis=0)
+    assert isinstance(e, BlockedScanExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.cumsum(a, axis=0), rtol=1e-4)
+
+
+def test_blocked_scan_ops(mesh2d):
+    rng = np.random.RandomState(2)
+    a = (0.9 + 0.2 * rng.rand(8192)).astype(np.float32)  # mul-safe
+    fa = st.from_numpy(a, tiling=tiling.row(1))
+    np.testing.assert_allclose(
+        np.asarray(st.scan(fa, op="mul").glom()), np.cumprod(a),
+        rtol=1e-3)
+    b = rng.randn(8192).astype(np.float32)
+    fb = st.from_numpy(b, tiling=tiling.row(1))
+    np.testing.assert_array_equal(
+        np.asarray(st.scan(fb, op="max").glom()),
+        np.maximum.accumulate(b))
+    np.testing.assert_array_equal(
+        np.asarray(st.scan(fb, op="min").glom()),
+        np.minimum.accumulate(b))
+
+
+def test_blocked_scan_int_max(mesh1d):
+    rng = np.random.RandomState(3)
+    a = rng.randint(-100, 100, size=4096).astype(np.int32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.row(1)), op="max")
+    assert isinstance(e, BlockedScanExpr)
+    np.testing.assert_array_equal(np.asarray(e.glom()),
+                                  np.maximum.accumulate(a))
+
+
+def test_scan_output_stays_sharded(mesh1d):
+    rng = np.random.RandomState(4)
+    a = rng.rand(8192).astype(np.float32)
+    out = st.cumsum(st.from_numpy(a, tiling=tiling.row(1))).evaluate()
+    shards = out.jax_array.addressable_shards
+    assert len({s.device for s in shards}) == 8
+    assert all(s.data.shape == (1024,) for s in shards)
+
+
+def test_scan_fallback_non_divisible(mesh1d):
+    rng = np.random.RandomState(5)
+    a = rng.rand(1001).astype(np.float32)
+    e = st.cumsum(st.from_numpy(a))
+    assert not isinstance(e, BlockedScanExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()), np.cumsum(a),
+                               rtol=1e-4)
+
+
+def test_scan_axis1_stays_local(mesh1d):
+    rng = np.random.RandomState(6)
+    a = rng.rand(64, 16).astype(np.float32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.row(2)), axis=1)
+    assert not isinstance(e, BlockedScanExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.cumsum(a, axis=1), rtol=1e-4)
+
+
+def test_scan_bool_promotes_via_local_path(mesh1d):
+    """bool cumsum promotes to int32 — must take the dtype-inferring
+    map path, not the blocked dispatch."""
+    mask = (np.arange(4096) % 3 == 0)
+    e = st.cumsum(st.from_numpy(mask))
+    assert not isinstance(e, BlockedScanExpr)
+    got = np.asarray(e.glom())
+    np.testing.assert_array_equal(got, np.cumsum(mask))
+
+
+def test_scan_col_sharded_stays_local(mesh2d):
+    """Axis 0 unsharded + axis 1 sharded: the local per-shard scan is
+    collective-free; the blocked dispatch must not force a reshard."""
+    rng = np.random.RandomState(7)
+    a = rng.rand(64, 16).astype(np.float32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.col(2)), axis=0)
+    assert not isinstance(e, BlockedScanExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.cumsum(a, axis=0), rtol=1e-4)
