@@ -442,15 +442,20 @@ class BlockedScanExpr(Expr):
     def _lower(self, env) -> Any:
         from ..ops import scan as scan_ops
 
-        return scan_ops.blocked_scan(self.x.lower(env), self.op)
+        return scan_ops.blocked_scan(self.x.lower(env), self.op,
+                                     in_axes=self.x.out_tiling().axes)
 
     def _sig(self, ctx):
-        return ("blocked_scan", self.op, ctx.of(self.x))
+        # trailing-axis sharding changes the lowered program
+        return ("blocked_scan", self.op, self.x.out_tiling().axes,
+                ctx.of(self.x))
 
     def _default_tiling(self):
         from ..array import tiling as tiling_mod
+        from ..ops import scan as scan_ops
 
-        return tiling_mod.row(self.ndim)
+        t = scan_ops.scan_axes(self.x.out_tiling().axes, self.ndim)
+        return tiling_mod.sanitize(t, self.shape)
 
 
 def _blocked_scannable(x: Expr, axis: int, op: str) -> bool:

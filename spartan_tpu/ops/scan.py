@@ -59,10 +59,29 @@ def _kernel(xs: jax.Array, axis_name, p: int, op: str) -> jax.Array:
     return _COMBINE[op](local, excl[me])
 
 
-def blocked_scan(x: jax.Array, op: str = "add", mesh=None) -> jax.Array:
+def scan_axes(in_axes, ndim: int):
+    """The sharding the blocked scan runs under: scan axis on the mesh
+    row axis, trailing axes KEEPING their existing mesh assignment
+    (the kernel is independent per trailing-axis shard — de-sharding
+    columns of a block-tiled operand would all-gather them for
+    nothing). A trailing axis that conflicts with the row axis is
+    dropped to replicated."""
+    row = tiling_mod.AXIS_ROW
+    trailing = list(tuple(in_axes or ())[1:]) + [None] * ndim
+    axes = [row]
+    for a in trailing[:ndim - 1]:
+        conflict = a == row or (isinstance(a, tuple) and row in a)
+        axes.append(None if conflict else a)
+    return tiling_mod.Tiling(axes)
+
+
+def blocked_scan(x: jax.Array, op: str = "add", mesh=None,
+                 in_axes=None) -> jax.Array:
     """Inclusive prefix scan along axis 0, distributed over the mesh
-    row axis. Traceable; falls back to the local cumulative op when
-    the axis does not shard evenly (same contract as sample_sort)."""
+    row axis. ``in_axes`` (the operand's tiling axes, when known)
+    keeps trailing-axis sharding intact. Traceable; falls back to the
+    local cumulative op when the axis does not shard evenly (same
+    contract as sample_sort)."""
     from jax import shard_map
 
     if op not in _LOCAL:
@@ -73,8 +92,11 @@ def blocked_scan(x: jax.Array, op: str = "add", mesh=None) -> jax.Array:
     n = int(x.shape[0])
     if p <= 1 or n == 0 or n % p != 0:
         return _LOCAL[op](x, axis=0)
-    row = tiling_mod.Tiling((axis,) + (None,) * (x.ndim - 1))
-    x = jax.lax.with_sharding_constraint(x, row.sharding(mesh))
+    t = scan_axes(in_axes, x.ndim)
+    t = tiling_mod.sanitize(t, x.shape, mesh)
+    if t.mesh_axis_of(0) is None:  # sanitize dropped the scan axis
+        return _LOCAL[op](x, axis=0)
+    x = jax.lax.with_sharding_constraint(x, t.sharding(mesh))
     mapped = shard_map(lambda v: _kernel(v, axis, p, op), mesh=mesh,
-                       in_specs=(row.spec(),), out_specs=row.spec())
+                       in_specs=(t.spec(),), out_specs=t.spec())
     return mapped(x)

@@ -1,0 +1,152 @@
+"""Randomized property tests over the geometry + redistribution layer
+(SURVEY.md §2.2 extent algebra, §2.3 shuffle): hundreds of random
+cases per property, seeded for reproducibility. These are the
+invariants every higher layer leans on — region math must be exact and
+a scatter-everything shuffle must reconstruct its input bit-for-bit
+under any tiling."""
+
+import numpy as np
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling as tiling_mod
+from spartan_tpu.array.extent import TileExtent
+
+
+def _rand_extent(rng, shape):
+    ul = [rng.randint(0, max(d, 1)) for d in shape]
+    lr = [min(d, u + 1 + rng.randint(0, max(d - u, 1)))
+          for u, d in zip(ul, shape)]
+    return TileExtent(ul, lr, shape)
+
+
+def test_extent_intersection_matches_set_semantics():
+    """intersection == the numpy mask intersection, for 300 random
+    pairs across 1-D/2-D/3-D shapes."""
+    rng = np.random.RandomState(0)
+    for _ in range(300):
+        nd = rng.randint(1, 4)
+        shape = tuple(rng.randint(1, 9) for _ in range(nd))
+        a, b = _rand_extent(rng, shape), _rand_extent(rng, shape)
+        mask_a = np.zeros(shape, bool)
+        mask_a[a.to_slice()] = True
+        mask_b = np.zeros(shape, bool)
+        mask_b[b.to_slice()] = True
+        both = mask_a & mask_b
+        isect = a.intersection(b)
+        if isect is None:
+            assert not both.any()
+        else:
+            mask_i = np.zeros(shape, bool)
+            mask_i[isect.to_slice()] = True
+            assert (mask_i == both).all()
+            # symmetric, contained in both, idempotent
+            assert b.intersection(a) == isect
+            assert a.contains(isect) and b.contains(isect)
+            assert isect.intersection(isect) == isect
+
+
+def test_extent_offset_roundtrip():
+    """offset_from/offset_slice index the enclosing block exactly."""
+    rng = np.random.RandomState(1)
+    for _ in range(200):
+        nd = rng.randint(1, 4)
+        shape = tuple(rng.randint(2, 10) for _ in range(nd))
+        outer = _rand_extent(rng, shape)
+        # inner: random sub-extent of outer
+        inner_ul = [rng.randint(u, lr) for u, lr in
+                    zip(outer.ul, outer.lr)]
+        inner_lr = [rng.randint(iu + 1, lr + 1) for iu, lr in
+                    zip(inner_ul, outer.lr)]
+        inner = TileExtent(inner_ul, inner_lr, shape)
+        arr = np.arange(int(np.prod(shape))).reshape(shape)
+        block = arr[outer.to_slice()]
+        local = inner.offset_from(outer)
+        np.testing.assert_array_equal(block[local.to_slice()],
+                                      arr[inner.to_slice()])
+        np.testing.assert_array_equal(block[outer.offset_slice(inner)],
+                                      arr[inner.to_slice()])
+
+
+def test_tile_grid_partitions_exactly():
+    """Every tiling's extents() tile the array: disjoint, covering."""
+    rng = np.random.RandomState(2)
+    for tile_fn in (tiling_mod.row, tiling_mod.col, tiling_mod.block,
+                    tiling_mod.row_t, tiling_mod.block_t):
+        for _ in range(30):
+            shape = (int(rng.choice([4, 8, 12, 16])),
+                     int(rng.choice([2, 4, 6, 8])))
+            t = tiling_mod.sanitize(tile_fn(2), shape)
+            cover = np.zeros(shape, np.int32)
+            for e in t.extents(shape):
+                cover[e.to_slice()] += 1
+            # uniform coverage (replicated axes repeat regions evenly)
+            assert (cover == cover.flat[0]).all() and cover.flat[0] >= 1
+
+
+def test_sanitize_always_divisible():
+    rng = np.random.RandomState(3)
+    for _ in range(200):
+        nd = rng.randint(1, 4)
+        shape = tuple(rng.randint(1, 20) for _ in range(nd))
+        axes = [None] * nd
+        for i in range(nd):
+            if rng.rand() < 0.5:
+                axes[i] = tiling_mod.AXIS_ROW if i % 2 == 0 \
+                    else tiling_mod.AXIS_COL
+        t = tiling_mod.sanitize(tiling_mod.Tiling(axes), shape)
+        assert t.divisible(shape)
+
+
+def test_shuffle_identity_roundtrip_fuzz(mesh2d):
+    """Scatter every source tile to its own extent with random tilings
+    on both sides: the shuffle must reconstruct the array exactly."""
+    rng = np.random.RandomState(4)
+    tilings = [tiling_mod.row(2), tiling_mod.col(2), tiling_mod.block(2),
+               tiling_mod.row_t(2), tiling_mod.replicated(2)]
+    for trial in range(6):
+        shape = (int(rng.choice([8, 16, 24])), int(rng.choice([4, 8, 12])))
+        a = rng.rand(*shape).astype(np.float32)
+        t_in = tilings[trial % len(tilings)]
+        t_out = tilings[(trial + 2) % len(tilings)]
+
+        def ident_kernel(ext, block):
+            yield ext, block
+
+        out = st.shuffle(st.from_numpy(a, tiling=tiling_mod.sanitize(
+            t_in, shape)), ident_kernel, target_shape=shape,
+            tiling=tiling_mod.sanitize(t_out, shape), combiner="set")
+        np.testing.assert_array_equal(np.asarray(out.glom()), a)
+
+
+def test_shuffle_random_emissions_vs_numpy_add(mesh1d):
+    """Kernels emitting RANDOM (possibly overlapping) extents with the
+    add combiner match a numpy scatter-add oracle."""
+    rng = np.random.RandomState(5)
+    for trial in range(4):
+        src_shape = (16, 6)
+        tgt_shape = (int(rng.choice([8, 12])), int(rng.choice([4, 6])))
+        a = rng.rand(*src_shape).astype(np.float32)
+        # one fixed random plan per source row-block, precomputed so
+        # kernel invocations are deterministic
+        plans = {}
+        for i, e in enumerate(
+                tiling_mod.row(2).extents(src_shape)):
+            r2 = np.random.RandomState(100 + trial * 50 + i)
+            emits = []
+            for _ in range(r2.randint(1, 4)):
+                te = _rand_extent(r2, tgt_shape)
+                emits.append((te, r2.rand(*te.shape).astype(np.float32)))
+            plans[e.ul] = emits
+
+        def kern(ext, block):
+            for te, data in plans[ext.ul]:
+                yield te, data
+
+        oracle = np.zeros(tgt_shape, np.float32)
+        for e in tiling_mod.row(2).extents(src_shape):
+            for te, data in plans[e.ul]:
+                oracle[te.to_slice()] += data
+        out = st.shuffle(st.from_numpy(a, tiling=tiling_mod.row(2)),
+                         kern, target_shape=tgt_shape, combiner="add")
+        np.testing.assert_allclose(np.asarray(out.glom()), oracle,
+                                   rtol=1e-5)

@@ -100,3 +100,20 @@ def test_scan_col_sharded_stays_local(mesh2d):
     assert not isinstance(e, BlockedScanExpr)
     np.testing.assert_allclose(np.asarray(e.glom()),
                                np.cumsum(a, axis=0), rtol=1e-4)
+
+
+def test_scan_block_tiled_keeps_column_sharding(mesh2d):
+    """A block-tiled operand keeps its column sharding through the
+    blocked scan — no all-gather of the trailing axis."""
+    rng = np.random.RandomState(8)
+    a = rng.rand(64, 8).astype(np.float32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.block(2)), axis=0)
+    assert isinstance(e, BlockedScanExpr)
+    assert e.out_tiling().axes == ("x", "y")
+    out = e.evaluate()
+    np.testing.assert_allclose(np.asarray(out.glom()),
+                               np.cumsum(a, axis=0), rtol=1e-4)
+    # result shards stay 2-D block partitioned over all 8 devices
+    shards = out.jax_array.addressable_shards
+    assert len({s.device for s in shards}) == 8
+    assert all(s.data.shape == (16, 4) for s in shards)
