@@ -118,3 +118,39 @@ def load_tree(path: str, nthreads: int = 8) -> Dict[str, DistArray]:
         names = json.load(f)["names"]
     return {n: load(os.path.join(path, n), nthreads=nthreads)
             for n in names}
+
+
+def save_sparse(path: str, sp, nthreads: int = 8) -> None:
+    """Checkpoint a SparseDistArray: the three entry-sharded component
+    arrays via the per-shard blob writer plus sparse metadata (shape,
+    nnz) — the sparse-tile analogue of the reference's per-tile IO."""
+    os.makedirs(path, exist_ok=True)
+    for name, arr in (("data", sp.data), ("rows", sp.rows),
+                      ("cols", sp.cols)):
+        t = tiling_mod.Tiling((tiling_mod.AXIS_ROW,))
+        save(os.path.join(path, name),
+             DistArray(arr, t, sp.mesh), nthreads)
+    with open(os.path.join(path, "sparse.json"), "w") as f:
+        json.dump({"shape": list(sp.shape), "nnz": int(sp.nnz)}, f)
+
+
+def load_sparse(path: str, nthreads: int = 8):
+    """Load a sparse checkpoint, re-sharding the entry axis onto the
+    current mesh (elastic restart, same as dense load).
+
+    The saved padding divided the SAVE-time mesh; rebuilding through
+    ``from_coo`` on the real (unpadded) entries re-pads for the
+    CURRENT mesh — wrapping the raw arrays would leave an entry count
+    the new mesh cannot shard evenly."""
+    from ..array.sparse import SparseDistArray
+
+    with open(os.path.join(path, "sparse.json")) as f:
+        meta = json.load(f)
+    parts = {name: np.asarray(load(os.path.join(path, name),
+                                   nthreads=nthreads).glom())
+             for name in ("data", "rows", "cols")}
+    nnz = int(meta["nnz"])
+    return SparseDistArray.from_coo(parts["rows"][:nnz],
+                                    parts["cols"][:nnz],
+                                    parts["data"][:nnz],
+                                    tuple(meta["shape"]))

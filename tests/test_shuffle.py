@@ -196,3 +196,18 @@ def test_shuffle_host_residency_bounded(mesh1d, monkeypatch):
         f"peak host residency {live['peak']} ~ full target {full_bytes}"
     # deterministic 'set' order: the LAST source tile (row 7) wins
     assert float(np.asarray(out.glom())[0, 0]) == a[7, 0]
+
+
+def test_shuffle_kernel_error_propagates(mesh1d):
+    """A kernel raising in a pool thread surfaces to the caller (the
+    reference's remote-exception propagation, SURVEY.md §2.1 RPC)."""
+    a = np.ones((16, 4), np.float32)
+
+    def bad_kernel(ext, block):
+        if ext.ul[0] >= 8:
+            raise ValueError(f"kernel failed on tile {ext.ul}")
+        yield ext, block
+
+    with pytest.raises(ValueError, match="kernel failed on tile"):
+        st.shuffle(st.from_numpy(a, tiling=tiling.row(2)), bad_kernel,
+                   target_shape=(16, 4), combiner="set")
