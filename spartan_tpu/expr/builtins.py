@@ -34,7 +34,7 @@ __all__ = [
     "rint", "negative", "reciprocal", "add", "subtract", "multiply",
     "divide", "true_divide", "mod", "not_equal", "greater", "greater_equal",
     "less", "less_equal", "logical_and", "logical_or", "logical_xor",
-    "outer_product",
+    "outer_product", "einsum", "tensordot", "matmul", "trace", "inner",
 ]
 
 
@@ -412,6 +412,68 @@ def cumsum(x, axis: int = 0) -> Expr:
 
 def cumprod(x, axis: int = 0) -> Expr:
     return scan(x, axis=axis, op="mul")
+
+
+def einsum(subscripts: str, *operands, precision=None) -> Expr:
+    """NumPy-style einsum over lazy operands: one traced contraction,
+    sharded by GSPMD from the operands' tilings (the subscripts ride
+    the compile-cache key explicitly)."""
+    from .map2 import map2
+
+    return map2([as_expr(o) for o in operands],
+                lambda *xs, subscripts, precision: jnp.einsum(
+                    subscripts, *xs, precision=precision),
+                fn_kw={"subscripts": subscripts, "precision": precision})
+
+
+def tensordot(a, b, axes=2) -> Expr:
+    """NumPy ``tensordot`` (axes spec normalized for cache-key
+    hashability)."""
+    from .map2 import map2
+
+    if isinstance(axes, (list, tuple)):
+        ax0, ax1 = axes
+        axes = (tuple(np.atleast_1d(ax0).tolist()),
+                tuple(np.atleast_1d(ax1).tolist()))
+    else:
+        axes = int(axes)
+    return map2([as_expr(a), as_expr(b)],
+                lambda x, y, axes: jnp.tensordot(x, y, axes=axes),
+                fn_kw={"axes": axes})
+
+
+def matmul(a, b, precision=None) -> Expr:
+    """``a @ b``: 1-D/2-D operands route through the smart-tiling
+    DotExpr; batched (>2-D) operands are a traced ``jnp.matmul``."""
+    from .dot import dot as dot_expr
+    from .map2 import map2
+
+    a, b = as_expr(a), as_expr(b)
+    if a.ndim <= 2 and b.ndim <= 2:
+        return dot_expr(a, b, precision=precision)
+    return map2([a, b],
+                lambda x, y, precision: jnp.matmul(
+                    x, y, precision=precision),
+                fn_kw={"precision": precision})
+
+
+def trace(x, offset: int = 0) -> Expr:
+    from .map2 import map2
+
+    return map2([as_expr(x)],
+                lambda v, offset: jnp.trace(v, offset=offset),
+                fn_kw={"offset": offset})
+
+
+def inner(a, b) -> Expr:
+    """NumPy ``inner``: 1-D operands contract (a dot); otherwise the
+    last-axis contraction via a traced einsum."""
+    a, b = as_expr(a), as_expr(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return dot(a, b)
+    from .map2 import map2
+
+    return map2([a, b], lambda x, y: jnp.inner(x, y))
 
 
 def outer_product(a, b) -> Expr:

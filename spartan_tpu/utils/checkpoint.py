@@ -76,9 +76,8 @@ def save(path: str, array: Union[DistArray, "np.ndarray"],
         json.dump(manifest, f)
 
 
-def load(path: str, tiling: Optional[tiling_mod.Tiling] = None,
-         nthreads: int = 8) -> DistArray:
-    """Read a checkpoint and re-shard it onto the current mesh."""
+def _load_host(path: str, nthreads: int = 8):
+    """Read a checkpoint into a host array (no device transfer)."""
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
     shape = tuple(manifest["shape"])
@@ -94,10 +93,17 @@ def load(path: str, tiling: Optional[tiling_mod.Tiling] = None,
     native.read_blobs(paths, [b for _, b in targets], nthreads)
     for ext, buf in targets:
         full[ext.to_slice()] = buf
+    return full, manifest
+
+
+def load(path: str, tiling: Optional[tiling_mod.Tiling] = None,
+         nthreads: int = 8) -> DistArray:
+    """Read a checkpoint and re-shard it onto the current mesh."""
+    full, manifest = _load_host(path, nthreads)
     if tiling is None:
         saved = _axes_from_json(manifest["tiling"])
         t = tiling_mod.Tiling(saved)
-        t = tiling_mod.sanitize(t, shape)
+        t = tiling_mod.sanitize(t, full.shape)
     else:
         t = tiling
     return da.from_numpy(full, tiling=t)
@@ -124,10 +130,12 @@ def save_sparse(path: str, sp, nthreads: int = 8) -> None:
     """Checkpoint a SparseDistArray: the three entry-sharded component
     arrays via the per-shard blob writer plus sparse metadata (shape,
     nnz) — the sparse-tile analogue of the reference's per-tile IO."""
+    from ..array.sparse import _entry_tiling
+
     os.makedirs(path, exist_ok=True)
+    t = _entry_tiling(sp.mesh)  # the components' actual layout
     for name, arr in (("data", sp.data), ("rows", sp.rows),
                       ("cols", sp.cols)):
-        t = tiling_mod.Tiling((tiling_mod.AXIS_ROW,))
         save(os.path.join(path, name),
              DistArray(arr, t, sp.mesh), nthreads)
     with open(os.path.join(path, "sparse.json"), "w") as f:
@@ -146,8 +154,8 @@ def load_sparse(path: str, nthreads: int = 8):
 
     with open(os.path.join(path, "sparse.json")) as f:
         meta = json.load(f)
-    parts = {name: np.asarray(load(os.path.join(path, name),
-                                   nthreads=nthreads).glom())
+    # host-only blob reads: from_coo does the single device_put
+    parts = {name: _load_host(os.path.join(path, name), nthreads)[0]
              for name in ("data", "rows", "cols")}
     nnz = int(meta["nnz"])
     return SparseDistArray.from_coo(parts["rows"][:nnz],

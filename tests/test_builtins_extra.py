@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import spartan_tpu as st
+from spartan_tpu.array import tiling
 
 
 @pytest.fixture(autouse=True)
@@ -109,3 +110,54 @@ def test_stencil_top_level():
     out = st.maxpool(st.from_numpy(img), window=2, stride=2).glom()
     expect = img.reshape(2, 4, 2, 4, 2, 1).max(axis=(2, 4))
     np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_einsum_family(mesh2d):
+    """einsum / tensordot / matmul / trace / inner vs NumPy oracles on
+    sharded operands."""
+    rng = np.random.RandomState(30)
+    a = rng.rand(16, 8).astype(np.float32)
+    b = rng.rand(8, 12).astype(np.float32)
+    ea = st.from_numpy(a, tiling=tiling.row(2))
+    eb = st.from_numpy(b, tiling=tiling.col(2))
+    np.testing.assert_allclose(
+        np.asarray(st.einsum("ij,jk->ik", ea, eb).glom()), a @ b,
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(st.einsum("ij->j", ea).glom()), a.sum(axis=0),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(st.tensordot(ea, eb, axes=([1], [0])).glom()),
+        np.tensordot(a, b, axes=([1], [0])), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(st.matmul(ea, eb).glom()), a @ b, rtol=1e-4)
+    # batched matmul (>2-D) takes the traced path
+    c = rng.rand(4, 8, 8).astype(np.float32)
+    d = rng.rand(4, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(st.matmul(st.from_numpy(c), st.from_numpy(d)).glom()),
+        c @ d, rtol=1e-4)
+    sq = rng.rand(12, 12).astype(np.float32)
+    np.testing.assert_allclose(
+        float(st.trace(st.from_numpy(sq)).glom()), np.trace(sq),
+        rtol=1e-5)
+    v = rng.rand(32).astype(np.float32)
+    w = rng.rand(32).astype(np.float32)
+    np.testing.assert_allclose(
+        float(st.inner(st.from_numpy(v), st.from_numpy(w)).glom()),
+        np.inner(v, w), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(st.inner(ea, st.from_numpy(b.T)).glom()),
+        np.inner(a, b.T), rtol=1e-4)
+
+
+def test_einsum_cache_keys_on_subscripts(mesh2d):
+    """Different subscripts on same-shaped operands must not collide
+    in the compile cache."""
+    rng = np.random.RandomState(31)
+    a = rng.rand(8, 8).astype(np.float32)
+    ea = st.from_numpy(a)
+    s1 = np.asarray(st.einsum("ij->ji", ea).glom())
+    s2 = np.asarray(st.einsum("ij->ij", ea).glom())
+    np.testing.assert_array_equal(s1, a.T)
+    np.testing.assert_array_equal(s2, a)
