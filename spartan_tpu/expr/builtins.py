@@ -531,7 +531,7 @@ def _blocked_scannable(x: Expr, axis: int, op: str) -> bool:
     from ..parallel import mesh as mesh_mod
     from ..array import tiling as tiling_mod
 
-    if x.ndim not in (1, 2) or axis not in (0, -x.ndim):
+    if x.ndim < 1 or axis not in (0, -x.ndim):
         return False
     p = int(mesh_mod.get_mesh().shape.get(tiling_mod.AXIS_ROW, 1))
     if p <= 1 or x.shape[0] == 0 or x.shape[0] % p != 0:
@@ -541,7 +541,7 @@ def _blocked_scannable(x: Expr, axis: int, op: str) -> bool:
     if out.dtype != x.dtype:
         return False
     t = x.out_tiling()
-    if (x.ndim == 2 and t.mesh_axis_of(0) is None
+    if (x.ndim >= 2 and t.mesh_axis_of(0) is None
             and t.sharded_axes()):
         return False
     return True
@@ -550,10 +550,11 @@ def _blocked_scannable(x: Expr, axis: int, op: str) -> bool:
 def scan(x, axis: int = 0, op: str = "add") -> Expr:
     """Prefix scan along an axis (exercised by SSVD per BASELINE.json:11).
 
-    Axis 0 of a 1-D/2-D array on a multi-device mesh (row axis
-    dividing the length) runs the distributed blocked scan
-    (ops/scan.py); other axes lower to ``jnp.cumsum``-family ops —
-    local per shard when the scan axis is unsharded."""
+    The leading axis of any-rank arrays on a multi-device mesh (row
+    axis dividing the length) runs the distributed blocked scan
+    (ops/scan.py), trailing-axis sharding preserved; other axes lower
+    to ``jnp.cumsum``-family ops — local per shard when the scan axis
+    is unsharded."""
     from ..ops import scan as scan_ops
 
     x = as_expr(x)

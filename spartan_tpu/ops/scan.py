@@ -14,7 +14,8 @@ decomposition is one shard_map program with static shapes:
 4. combine my shard's local scan with my exclusive offset.
 
 Supports add / mul / max / min (the combine in step 4 uses the same
-associative op), scanning axis 0 of 1-D or 2-D row-sharded arrays.
+associative op), scanning axis 0 of row-sharded arrays of any rank
+(trailing-axis sharding is preserved through the shard_map specs).
 """
 
 from __future__ import annotations

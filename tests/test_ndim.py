@@ -1,0 +1,86 @@
+"""N-dimensional coverage (the reference is an N-d array framework —
+SURVEY.md §1): 3-D/4-D arrays through map, reduce, slice, transpose,
+reshape, scan, and masked ops on the 8-virtual-device mesh, NumPy as
+the oracle."""
+
+import numpy as np
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+from spartan_tpu.expr.builtins import BlockedScanExpr
+
+
+def test_3d_map_reduce_chain(mesh2d):
+    rng = np.random.RandomState(0)
+    a = rng.rand(8, 6, 4).astype(np.float32)
+    b = rng.rand(8, 6, 4).astype(np.float32)
+    ea, eb = st.from_numpy(a), st.from_numpy(b)
+    np.testing.assert_allclose(
+        np.asarray((ea * eb + 1.0).sum(axis=1).glom()),
+        (a * b + 1.0).sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray((ea - eb).max(axis=(0, 2)).glom()),
+        (a - b).max(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(float((ea / (eb + 1.0)).mean().glom()),
+                               (a / (b + 1.0)).mean(), rtol=1e-5)
+
+
+def test_3d_slice_transpose_reshape(mesh2d):
+    rng = np.random.RandomState(1)
+    a = rng.rand(8, 6, 4).astype(np.float32)
+    ea = st.from_numpy(a)
+    np.testing.assert_array_equal(np.asarray(ea[2:5, :, 1:3].glom()),
+                                  a[2:5, :, 1:3])
+    np.testing.assert_array_equal(
+        np.asarray(ea.transpose((2, 0, 1)).glom()),
+        a.transpose((2, 0, 1)))
+    np.testing.assert_array_equal(np.asarray(ea.reshape((48, 4)).glom()),
+                                  a.reshape(48, 4))
+    np.testing.assert_array_equal(np.asarray(st.ravel(ea).glom()),
+                                  a.ravel())
+
+
+def test_3d_blocked_scan(mesh1d):
+    """3-D leading-axis scan takes the blocked distributed path and
+    keeps trailing shape."""
+    rng = np.random.RandomState(2)
+    a = rng.rand(64, 6, 4).astype(np.float32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.Tiling(("x", None, None))),
+                axis=0)
+    assert isinstance(e, BlockedScanExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.cumsum(a, axis=0), rtol=1e-4)
+
+
+def test_4d_elementwise_and_full_reduce(mesh2d):
+    rng = np.random.RandomState(3)
+    a = rng.rand(8, 4, 2, 6).astype(np.float32)
+    ea = st.from_numpy(a)
+    np.testing.assert_allclose(float(st.sqrt(ea * ea).sum().glom()),
+                               a.sum(), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ea.sum(axis=(1, 3)).glom()), a.sum(axis=(1, 3)),
+        rtol=1e-4)
+
+
+def test_3d_einsum_batched(mesh2d):
+    rng = np.random.RandomState(4)
+    a = rng.rand(8, 6, 4).astype(np.float32)
+    b = rng.rand(8, 4, 5).astype(np.float32)
+    got = st.einsum("bij,bjk->bik", st.from_numpy(a), st.from_numpy(b))
+    np.testing.assert_allclose(np.asarray(got.glom()),
+                               np.einsum("bij,bjk->bik", a, b),
+                               rtol=1e-4)
+
+
+def test_3d_blocked_scan_trailing_sharded(mesh2d):
+    """3-D scan with a SHARDED trailing axis: the blocked path keeps
+    the trailing shards (no all-gather of axis 1)."""
+    rng = np.random.RandomState(5)
+    a = rng.rand(32, 8, 4).astype(np.float32)
+    e = st.scan(st.from_numpy(a, tiling=tiling.Tiling(("x", "y", None))),
+                axis=0)
+    assert isinstance(e, BlockedScanExpr)
+    assert e.out_tiling().axes == ("x", "y", None)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.cumsum(a, axis=0), rtol=1e-4)
