@@ -1,0 +1,64 @@
+"""Failure detection + lineage recovery (SURVEY.md §5).
+
+The reference's master marked workers dead on missed heartbeats and
+could at best recompute lost tiles from the expression DAG. In the
+single-controller XLA runtime, DETECTION is the runtime error the
+failed dispatch raises (device loss / preemption surfaces as an
+exception from the blocking call — there is no silent partial state,
+because arrays are immutable and a failed program commits nothing),
+and RECOVERY is recompute-from-lineage: exprs are deterministic, so
+dropping the cached result and re-forcing the DAG rebuilds it — the
+reference's recompute-lost-tiles story without per-tile bookkeeping.
+
+This module packages that loop; the fault-injection test
+(tests/test_aux.py) exercises it end to end.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from .log import log_warn
+
+# Exception types that indicate a (possibly transient) runtime/device
+# failure rather than a user error. jax raises XlaRuntimeError for
+# device-side faults; OSError covers the IO layer during checkpoint
+# reads. ValueError/TypeError etc. are USER errors and must not be
+# retried.
+_DEFAULT_RETRYABLE: Tuple[type, ...]
+try:  # pragma: no cover - import surface varies across jax versions
+    from jax.errors import JaxRuntimeError as _JaxRT
+
+    _DEFAULT_RETRYABLE = (_JaxRT, RuntimeError, OSError)
+except Exception:  # pragma: no cover
+    _DEFAULT_RETRYABLE = (RuntimeError, OSError)
+
+
+def evaluate_with_recovery(expr: Any, retries: int = 2,
+                           backoff_s: float = 0.0,
+                           retryable: Optional[Tuple[type, ...]] = None,
+                           on_failure: Optional[Callable] = None):
+    """Force ``expr`` with detection + lineage recovery.
+
+    On a retryable runtime failure: drop the cached partial result
+    (``invalidate`` — lineage, i.e. the DAG itself, is the recovery
+    log), optionally call ``on_failure(attempt, exc)`` (hook for
+    re-initializing a backend or reloading a checkpoint), and
+    re-force. Non-retryable exceptions propagate immediately.
+    """
+    retryable = retryable or _DEFAULT_RETRYABLE
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return expr.evaluate()
+        except retryable as e:  # detection: the failed dispatch raises
+            last = e
+            log_warn("evaluate failed (attempt %d/%d): %s",
+                     attempt + 1, retries + 1, e)
+            expr.invalidate()
+            if on_failure is not None:
+                on_failure(attempt, e)
+            if backoff_s:
+                time.sleep(backoff_s * (2 ** attempt))
+    raise last

@@ -140,3 +140,44 @@ def test_fault_injection_lineage_recovery(monkeypatch):
     assert state["attempts"] == 3
     np.testing.assert_allclose(np.asarray(out.glom()), expected,
                                rtol=1e-6)
+
+
+def test_evaluate_with_recovery_api(monkeypatch):
+    """The packaged detection+recovery loop (utils/recovery.py):
+    transient runtime faults retry from lineage; user errors
+    propagate immediately."""
+    from spartan_tpu.utils.recovery import evaluate_with_recovery
+
+    x = st.from_numpy(np.full((4, 4), 2.0, np.float32))
+    e = (x * x).sum()
+
+    calls = {"n": 0, "hook": []}
+    real = type(e).evaluate
+
+    def flaky(self):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("injected device loss")
+        return real(self)
+
+    monkeypatch.setattr(type(e), "evaluate", flaky)
+    out = evaluate_with_recovery(
+        e, retries=3, on_failure=lambda a, exc: calls["hook"].append(a))
+    monkeypatch.undo()
+    assert calls["n"] == 3 and calls["hook"] == [0, 1]
+    np.testing.assert_allclose(np.asarray(out.glom()), 64.0)
+
+    # a user error is NOT retried
+    bad = st.from_numpy(np.ones((4, 4), np.float32))
+    b = (bad * 1.0).sum()
+
+    def user_error(self):
+        calls["n"] += 100
+        raise ValueError("user bug")
+
+    monkeypatch.setattr(type(b), "evaluate", user_error)
+    before = calls["n"]
+    with pytest.raises(ValueError):
+        evaluate_with_recovery(b, retries=3)
+    monkeypatch.undo()
+    assert calls["n"] == before + 100  # exactly one attempt
