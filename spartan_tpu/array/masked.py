@@ -155,7 +155,12 @@ class MaskedDistArray:
     def prod(self, axis=None) -> Expr:
         return _rprod(self.filled(1), axis=axis)
 
-    def mean(self, axis=None) -> Expr:
+    def mean(self, axis=None, keepdims: bool = False) -> Expr:
+        if keepdims and axis is not None:
+            valid = bi.where(self.mask, 0, 1)
+            cnt_k = _rsum(valid, axis=axis, keepdims=True)
+            return (_rsum(self.filled(0), axis=axis, keepdims=True)
+                    / bi.maximum(cnt_k, 1))
         return self.sum(axis) / self.count(axis)
 
     def var(self, axis=None) -> Expr:
@@ -169,11 +174,7 @@ class MaskedDistArray:
             d = self.filled(0) - self.mean(None)
             sq = bi.where(self.mask, 0.0, d * d)
             return _rsum(sq, axis=None) / self.count(None)
-        valid = bi.where(self.mask, 0, 1)
-        cnt_k = _rsum(valid, axis=axis, keepdims=True)
-        mean_k = (_rsum(self.filled(0), axis=axis, keepdims=True)
-                  / bi.maximum(cnt_k, 1))
-        d = self.data - mean_k
+        d = self.data - self.mean(axis, keepdims=True)
         sq = bi.where(self.mask, 0.0, d * d)
         return _rsum(sq, axis=axis) / self.count(axis)
 
@@ -191,6 +192,38 @@ class MaskedDistArray:
         hi = _finfo_extreme(self.dtype, lo=False)
         out = _rmin(self.filled(hi), axis=axis)
         return MaskedDistArray(out, bi.equal(self.count(axis), 0))
+
+    def average(self, axis=None, weights: Any = None) -> Expr:
+        """``numpy.ma.average``: weighted mean skipping masked elements
+        (weights of masked positions contribute nothing). Like
+        numpy.ma, a 1-D ``weights`` of length ``shape[axis]``
+        broadcasts along the reduction axis."""
+        if weights is None:
+            return self.mean(axis)
+        w = as_expr(weights)
+        nd = len(self.shape)
+        if (w.ndim == 1 and axis is not None and nd > 1
+                and w.shape[0] == self.shape[axis % nd]):
+            bshape = [1] * nd
+            bshape[axis % nd] = w.shape[0]
+            w = w.reshape(tuple(bshape))
+        wv = bi.where(self.mask, 0.0, w)
+        num = _rsum(self.filled(0) * wv, axis=axis)
+        den = _rsum(wv, axis=axis)
+        return num / den
+
+    def anom(self, axis=None) -> "MaskedDistArray":
+        """``numpy.ma.anom``: data minus the (masked) mean along
+        ``axis``, masked where the input is."""
+        mean = (self.mean(None) if axis is None
+                else self.mean(axis, keepdims=True))
+        return MaskedDistArray(self.data - mean, self.mask)
+
+    def compressed(self) -> np.ndarray:
+        """``numpy.ma.compressed``: the unmasked elements as a 1-D host
+        array (dynamic shape — necessarily a host materialization)."""
+        out = self.glom()
+        return np.ma.compressed(out)
 
     # -- materialization ------------------------------------------------
 

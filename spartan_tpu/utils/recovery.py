@@ -22,17 +22,11 @@ from typing import Any, Callable, Optional, Tuple
 from .log import log_warn
 
 # Exception types that indicate a (possibly transient) runtime/device
-# failure rather than a user error. jax raises XlaRuntimeError for
-# device-side faults; OSError covers the IO layer during checkpoint
-# reads. ValueError/TypeError etc. are USER errors and must not be
-# retried.
-_DEFAULT_RETRYABLE: Tuple[type, ...]
-try:  # pragma: no cover - import surface varies across jax versions
-    from jax.errors import JaxRuntimeError as _JaxRT
-
-    _DEFAULT_RETRYABLE = (_JaxRT, RuntimeError, OSError)
-except Exception:  # pragma: no cover
-    _DEFAULT_RETRYABLE = (RuntimeError, OSError)
+# failure rather than a user error. jax's device-side faults
+# (XlaRuntimeError/JaxRuntimeError) subclass RuntimeError; OSError
+# covers the IO layer during checkpoint reads. ValueError/TypeError
+# etc. are USER errors and must not be retried.
+_DEFAULT_RETRYABLE: Tuple[type, ...] = (RuntimeError, OSError)
 
 
 def evaluate_with_recovery(expr: Any, retries: int = 2,
@@ -47,18 +41,18 @@ def evaluate_with_recovery(expr: Any, retries: int = 2,
     re-initializing a backend or reloading a checkpoint), and
     re-force. Non-retryable exceptions propagate immediately.
     """
-    retryable = retryable or _DEFAULT_RETRYABLE
-    last: Optional[BaseException] = None
+    if retryable is None:
+        retryable = _DEFAULT_RETRYABLE
     for attempt in range(retries + 1):
         try:
             return expr.evaluate()
         except retryable as e:  # detection: the failed dispatch raises
-            last = e
             log_warn("evaluate failed (attempt %d/%d): %s",
                      attempt + 1, retries + 1, e)
+            if attempt == retries:  # no further attempt: fail fast
+                raise
             expr.invalidate()
             if on_failure is not None:
                 on_failure(attempt, e)
             if backoff_s:
                 time.sleep(backoff_s * (2 ** attempt))
-    raise last

@@ -187,3 +187,41 @@ def test_var_std_fully_masked_slice(mesh2d):
     ref_std = np.ma.filled(nma.std(axis=1).astype(np.float64), np.nan)
     np.testing.assert_allclose(got_std, ref_std, rtol=1e-4,
                                equal_nan=True)
+
+
+def test_average_weighted(pair):
+    nma, sma = pair
+    w = np.linspace(1.0, 2.0, nma.size).reshape(nma.shape).astype(
+        np.float32)
+    np.testing.assert_allclose(
+        float(sma.average(weights=w).glom()),
+        np.ma.average(nma, weights=w), rtol=1e-5)
+    for axis in (0, 1):
+        got = np.asarray(sma.average(axis=axis, weights=w).glom())
+        ref = np.ma.filled(
+            np.ma.average(nma, axis=axis, weights=w).astype(np.float64),
+            np.nan)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, equal_nan=True)
+    # numpy.ma's 1-D per-axis weights form
+    w0 = np.linspace(0.5, 1.5, nma.shape[0]).astype(np.float32)
+    got = np.asarray(sma.average(axis=0, weights=w0).glom())
+    ref = np.ma.filled(
+        np.ma.average(nma, axis=0, weights=w0).astype(np.float64), np.nan)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, equal_nan=True)
+
+
+def test_anom(pair):
+    nma, sma = pair
+    for axis in (None, 0, 1):
+        got = sma.anom(axis=axis).glom()
+        ref = nma.anom(axis=axis)
+        np.testing.assert_allclose(
+            np.ma.filled(got.astype(np.float64), np.nan),
+            np.ma.filled(ref.astype(np.float64), np.nan),
+            rtol=1e-4, atol=1e-6, equal_nan=True)
+
+
+def test_compressed(pair):
+    nma, sma = pair
+    np.testing.assert_allclose(sma.compressed(), nma.compressed(),
+                               rtol=1e-6)
