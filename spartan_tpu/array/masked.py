@@ -138,10 +138,10 @@ class MaskedDistArray:
 
     # -- mask queries ---------------------------------------------------
 
-    def count(self, axis=None) -> Expr:
+    def count(self, axis=None, keepdims: bool = False) -> Expr:
         """Number of unmasked elements (``numpy.ma`` ``count``)."""
         valid = bi.where(self.mask, 0, 1)
-        return _rsum(valid, axis=axis)
+        return _rsum(valid, axis=axis, keepdims=keepdims)
 
     def filled(self, fill_value: Any = 0) -> Expr:
         """Data with masked elements replaced by ``fill_value``."""
@@ -149,19 +149,17 @@ class MaskedDistArray:
 
     # -- reductions (skip masked elements) ------------------------------
 
-    def sum(self, axis=None) -> Expr:
-        return _rsum(self.filled(0), axis=axis)
+    def sum(self, axis=None, keepdims: bool = False) -> Expr:
+        return _rsum(self.filled(0), axis=axis, keepdims=keepdims)
 
     def prod(self, axis=None) -> Expr:
         return _rprod(self.filled(1), axis=axis)
 
     def mean(self, axis=None, keepdims: bool = False) -> Expr:
-        if keepdims and axis is not None:
-            valid = bi.where(self.mask, 0, 1)
-            cnt_k = _rsum(valid, axis=axis, keepdims=True)
-            return (_rsum(self.filled(0), axis=axis, keepdims=True)
-                    / bi.maximum(cnt_k, 1))
-        return self.sum(axis) / self.count(axis)
+        """Masked mean; fully-masked slices are NaN (0/0 — the
+        Expr-level masked result) regardless of ``keepdims``."""
+        return (self.sum(axis, keepdims=keepdims)
+                / self.count(axis, keepdims=keepdims))
 
     def var(self, axis=None) -> Expr:
         """Masked variance (``numpy.ma`` semantics, ddof=0). Per-axis:
@@ -202,8 +200,16 @@ class MaskedDistArray:
             return self.mean(axis)
         w = as_expr(weights)
         nd = len(self.shape)
-        if (w.ndim == 1 and axis is not None and nd > 1
-                and w.shape[0] == self.shape[axis % nd]):
+        if w.ndim == 1 and nd > 1 and w.shape != self.shape:
+            # numpy.ma semantics for the 1-D per-axis weights form
+            if axis is None:
+                raise TypeError(
+                    "Axis must be specified when shapes of data and "
+                    "weights differ")
+            if w.shape[0] != self.shape[axis % nd]:
+                raise ValueError(
+                    f"Length of weights {w.shape[0]} not compatible "
+                    f"with axis {axis} of shape {self.shape}")
             bshape = [1] * nd
             bshape[axis % nd] = w.shape[0]
             w = w.reshape(tuple(bshape))

@@ -214,10 +214,10 @@ def concatenate(arrays, axis=0) -> Expr:
     return _concat(arrays, axis)
 
 
-def dot(a, b) -> Expr:
+def dot(a, b, precision=None) -> Expr:
     from .dot import dot as _dot
 
-    return _dot(a, b)
+    return _dot(a, b, precision=precision)
 
 
 def norm(x, ord=2) -> Expr:

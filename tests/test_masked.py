@@ -225,3 +225,29 @@ def test_compressed(pair):
     nma, sma = pair
     np.testing.assert_allclose(sma.compressed(), nma.compressed(),
                                rtol=1e-6)
+
+
+def test_mean_keepdims_consistency(pair):
+    """keepdims changes shape only — never values (fully-masked slices
+    NaN either way); axis=None keepdims keeps all-ones shape."""
+    nma, sma = pair
+    k = np.asarray(sma.mean(axis=0, keepdims=True).glom())
+    f = np.asarray(sma.mean(axis=0).glom())
+    assert k.shape == (1, nma.shape[1])
+    np.testing.assert_allclose(k[0], f, rtol=1e-6, equal_nan=True)
+    assert np.asarray(sma.mean(keepdims=True).glom()).shape == (1, 1)
+    # fully-masked column: NaN under BOTH keepdims settings
+    mask = np.zeros((4, 3), bool)
+    mask[:, 1] = True
+    m2 = MaskedDistArray(np.ones((4, 3), np.float32), mask)
+    assert np.isnan(np.asarray(m2.mean(axis=0).glom())[1])
+    assert np.isnan(np.asarray(m2.mean(axis=0, keepdims=True).glom())[0, 1])
+
+
+def test_average_rejects_bad_weights(pair):
+    nma, sma = pair
+    bad = np.ones(nma.shape[1] + 1, np.float32)
+    with pytest.raises(ValueError, match="not compatible"):
+        sma.average(axis=1, weights=bad)
+    with pytest.raises(TypeError, match="Axis must be specified"):
+        sma.average(weights=np.ones(nma.shape[0], np.float32))
