@@ -28,7 +28,8 @@ __all__ = [
     "argmin", "reduce", "ndarray", "norm", "diag", "diagonal", "tril",
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
-    "sort", "argsort", "median", "unique_counts", "isnan", "isinf",
+    "sort", "argsort", "median", "percentile", "unique_counts",
+    "isnan", "isinf",
     "isfinite", "logical_not", "var", "std", "ptp", "cumsum", "cumprod",
     "take", "linspace", "log1p", "expm1", "log2", "log10", "floor", "ceil",
     "rint", "negative", "reciprocal", "add", "subtract", "multiply",
@@ -353,8 +354,54 @@ def argsort(x, axis: int = -1) -> Expr:
     return map_expr(lambda v: jnp.argsort(v, axis=axis), x)
 
 
+def _nan_poison(x: Expr, rdt) -> Any:
+    """0 when ``x`` is NaN-free, NaN otherwise — added to distributed
+    order statistics so median/percentile propagate NaN exactly like
+    the traced jnp fallbacks (the sample sort orders NaN to one end,
+    which would otherwise silently hide it)."""
+    if not np.issubdtype(np.dtype(rdt), np.floating):
+        return 0.0
+    return astype(sum(x), rdt) * 0.0
+
+
 def median(x, axis=None) -> Expr:
-    return map_expr(lambda v: jnp.median(v, axis=axis), as_expr(x))
+    """Median; 1-D multi-device arrays route through the distributed
+    sample sort (two order statistics of the sorted result) instead of
+    gathering the axis. Matches the traced path's dtype promotion and
+    NaN propagation."""
+    x = as_expr(x)
+    if x.ndim == 1 and axis in (None, 0, -1) and \
+            _distributed_sortable(x, 0):
+        n = x.shape[0]
+        rdt = jnp.result_type(x.dtype, jnp.float32)
+        s = SampleSortExpr(x)
+        # promote BEFORE summing: int middles could overflow
+        mid = astype(s[(n - 1) // 2], rdt) + astype(s[n // 2], rdt)
+        return 0.5 * mid + _nan_poison(x, rdt)
+    return map_expr(lambda v: jnp.median(v, axis=axis), x)
+
+
+def percentile(x, q, axis=None) -> Expr:
+    """Percentile (linear interpolation); the 1-D multi-device case
+    rides the distributed sample sort like :func:`median`."""
+    x = as_expr(x)
+    qf = float(q)
+    if not 0.0 <= qf <= 100.0:
+        raise ValueError(f"percentile q={q} outside [0, 100]")
+    if x.ndim == 1 and axis in (None, 0, -1) and \
+            _distributed_sortable(x, 0):
+        n = x.shape[0]
+        rdt = jnp.result_type(x.dtype, jnp.float32)
+        pos = qf / 100.0 * (n - 1)
+        lo = int(np.floor(pos))
+        # NB: this module shadows builtin min() with the reduce op
+        hi = lo + 1 if lo + 1 <= n - 1 else n - 1
+        frac = pos - lo
+        s = SampleSortExpr(x)
+        out = (1.0 - frac) * astype(s[lo], rdt) \
+            + frac * astype(s[hi], rdt)
+        return out + _nan_poison(x, rdt)
+    return map_expr(lambda v: jnp.percentile(v, qf, axis=axis), x)
 
 
 def unique_counts(x, size: int) -> Expr:

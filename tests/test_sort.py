@@ -119,3 +119,41 @@ def test_argsort_fallback_non_divisible(mesh1d):
     assert not isinstance(e, SampleSortExpr)
     perm = np.asarray(e.glom())
     np.testing.assert_array_equal(a[perm], np.sort(a))
+
+
+def test_distributed_median_percentile(mesh1d):
+    """1-D sharded median/percentile ride the sample sort; oracle vs
+    numpy, odd and even lengths plus interpolated percentiles."""
+    rng = np.random.RandomState(11)
+    for n in (8192, 65_536):
+        a = rng.rand(n).astype(np.float32)
+        fa = st.from_numpy(a, tiling=tiling.row(1))
+        np.testing.assert_allclose(float(st.median(fa).glom()),
+                                   np.median(a), rtol=1e-6)
+        for q in (0.0, 25.0, 50.0, 90.5, 100.0):
+            np.testing.assert_allclose(
+                float(st.percentile(fa, q).glom()),
+                np.percentile(a, q), rtol=1e-5, atol=1e-7)
+    # non-divisible falls back to the traced path
+    b = rng.rand(1001).astype(np.float32)
+    np.testing.assert_allclose(float(st.median(st.from_numpy(b)).glom()),
+                               np.median(b), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(st.percentile(st.from_numpy(b), 30.0).glom()),
+        np.percentile(b, 30.0), rtol=1e-5)
+
+
+def test_distributed_median_nan_and_int(mesh1d):
+    """Distributed median/percentile match the traced semantics: NaN
+    propagates; int inputs promote before the middle sum."""
+    rng = np.random.RandomState(12)
+    a = rng.rand(8192).astype(np.float32)
+    a[137] = np.nan
+    fa = st.from_numpy(a, tiling=tiling.row(1))
+    assert np.isnan(float(st.median(fa).glom()))
+    assert np.isnan(float(st.percentile(fa, 75.0).glom()))
+    # int32 middles near the max must not wrap
+    big = np.full(4096, 2_000_000_000, np.int32)
+    fb = st.from_numpy(big, tiling=tiling.row(1))
+    np.testing.assert_allclose(float(st.median(fb).glom()), 2e9,
+                               rtol=1e-6)
