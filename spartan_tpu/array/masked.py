@@ -195,11 +195,21 @@ class MaskedDistArray:
         """``numpy.ma.average``: weighted mean skipping masked elements
         (weights of masked positions contribute nothing). Like
         numpy.ma, a 1-D ``weights`` of length ``shape[axis]``
-        broadcasts along the reduction axis."""
+        broadcasts along the reduction axis.
+
+        Divergence from numpy.ma: a zero weight-sum (all weights zero,
+        or a fully-masked slice) yields NaN in that slot rather than
+        raising ZeroDivisionError — the division happens inside a
+        traced XLA program where raising is impossible; NaN is the
+        Expr-level analogue of numpy.ma's error."""
         if weights is None:
             return self.mean(axis)
         w = as_expr(weights)
         nd = len(self.shape)
+        if w.ndim == 1 and nd == 1 and w.shape != self.shape:
+            raise ValueError(
+                f"Length of weights {w.shape[0]} not compatible "
+                f"with data of shape {self.shape}")
         if w.ndim == 1 and nd > 1 and w.shape != self.shape:
             # numpy.ma semantics for the 1-D per-axis weights form
             if axis is None:

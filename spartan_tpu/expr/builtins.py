@@ -358,10 +358,17 @@ def _nan_poison(x: Expr, rdt) -> Any:
     """0 when ``x`` is NaN-free, NaN otherwise — added to distributed
     order statistics so median/percentile propagate NaN exactly like
     the traced jnp fallbacks (the sample sort orders NaN to one end,
-    which would otherwise silently hide it)."""
-    if not np.issubdtype(np.dtype(rdt), np.floating):
-        return 0.0
-    return astype(sum(x), rdt) * 0.0
+    which would otherwise silently hide it).
+
+    Derived from NaN-ness alone: counting ``isnan`` per element keeps
+    inf inputs and f32 sum overflow (both of which poisoned the old
+    ``sum(x) * 0.0`` formulation with spurious NaN) out of the result."""
+    if not np.issubdtype(np.dtype(rdt), np.floating) or \
+            not np.issubdtype(np.dtype(x.dtype), np.floating):
+        return 0.0  # int inputs can't hold NaN: skip the scan entirely
+    cnt = sum(map_expr(lambda v: jnp.isnan(v).astype(jnp.float32), x))
+    return map_expr(
+        lambda c: jnp.where(c > 0, jnp.nan, 0.0).astype(rdt), cnt)
 
 
 def median(x, axis=None) -> Expr:
@@ -385,7 +392,13 @@ def percentile(x, q, axis=None) -> Expr:
     """Percentile (linear interpolation); the 1-D multi-device case
     rides the distributed sample sort like :func:`median`."""
     x = as_expr(x)
-    qf = float(q)
+    try:
+        qf = float(q)
+    except (TypeError, ValueError):
+        raise NotImplementedError(
+            "spartan_tpu.percentile supports scalar q only; got "
+            f"q={q!r}. Call percentile once per quantile (the sorted "
+            "intermediate is compile-cached across calls).")
     if not 0.0 <= qf <= 100.0:
         raise ValueError(f"percentile q={q} outside [0, 100]")
     if x.ndim == 1 and axis in (None, 0, -1) and \

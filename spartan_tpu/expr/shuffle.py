@@ -41,6 +41,7 @@ consumes task results strictly in source-tile order.
 from __future__ import annotations
 
 import bisect
+import os
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
@@ -53,6 +54,7 @@ from ..array import extent as extent_mod
 from ..array import tiling as tiling_mod
 from ..array.extent import TileExtent
 from ..array.tiling import Tiling
+from ..utils.log import log_debug
 from .base import Expr, ValExpr, as_expr, evaluate
 
 _COMBINERS = {
@@ -103,7 +105,13 @@ def shuffle(source: Any,
     reference's worker fan-out) — a kernel must be thread-safe with
     respect to any shared state it touches (combiner application
     itself stays serialized and deterministic).  Pass ``workers=1``
-    for the serial-invocation contract.
+    for the serial-invocation contract.  The pool defaults to
+    ``min(32, 4 x cpu_count, n_source_tiles)``.  Note the kernels
+    execute on the driver host under the CPython GIL: pure-Python
+    kernel bodies serialize regardless of pool size and only NumPy /
+    IO sections (which release the GIL) actually overlap — the pool
+    buys fetch/compute overlap and NumPy parallelism, not Python
+    parallelism.
     """
     source = as_expr(source)
     src = evaluate(source)
@@ -185,6 +193,10 @@ class _RegionIndex:
                       for d in range(ndim)): r
                 for r in self.regions}
         else:  # not a grid (shouldn't happen for mesh shardings)
+            log_debug(
+                "shuffle: %d target regions do not form a grid; "
+                "routing degrades to O(emissions x shards) linear scan",
+                len(self.regions))
             self._by_coord = None
 
     def hits(self, ext):
@@ -278,8 +290,15 @@ def _shuffle_sharded(src, kernel, kw, out_shape, out_dtype, out_tiling,
         return base
 
     src_extents = list(src.extents())
-    n_workers = max(1, min(workers or 8, len(src_extents)))
-    window = 2 * n_workers
+    if workers is None:
+        # scale with the machine and the work, capped: more threads than
+        # source tiles idle, and past ~4x cores they only add contention
+        workers = min(32, 4 * (os.cpu_count() or 1))
+    n_workers = max(1, min(workers, len(src_extents)))
+    # slack over the pool size keeps workers fed at the tile boundary;
+    # growing it 2x with the pool would scale peak buffered piece-copies
+    # with core count, so the prefetch margin stays small and fixed
+    window = n_workers + 4
     with ThreadPoolExecutor(max_workers=n_workers) as pool:
         pending = deque()
         todo = iter(enumerate(src_extents))

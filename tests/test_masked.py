@@ -251,3 +251,9 @@ def test_average_rejects_bad_weights(pair):
         sma.average(axis=1, weights=bad)
     with pytest.raises(TypeError, match="Axis must be specified"):
         sma.average(weights=np.ones(nma.shape[0], np.float32))
+    # 1-D data with wrong-length 1-D weights: caught up front, not as
+    # an opaque trace-time broadcast error (round-4 advisor, low)
+    d1 = MaskedDistArray(np.arange(6, dtype=np.float32),
+                         np.zeros(6, bool))
+    with pytest.raises(ValueError, match="not compatible"):
+        d1.average(weights=np.ones(4, np.float32))

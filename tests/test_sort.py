@@ -157,3 +157,34 @@ def test_distributed_median_nan_and_int(mesh1d):
     fb = st.from_numpy(big, tiling=tiling.row(1))
     np.testing.assert_allclose(float(st.median(fb).glom()), 2e9,
                                rtol=1e-6)
+
+
+def test_distributed_median_inf_not_poisoned(mesh1d):
+    """inf values (and f32 sums that overflow to inf) must NOT trip the
+    NaN poison — only genuine NaN does (round-4 advisor, medium)."""
+    a = np.arange(64, dtype=np.float32)
+    a[7] = np.inf
+    fa = st.from_numpy(a, tiling=tiling.row(1))
+    np.testing.assert_allclose(float(st.median(fa).glom()),
+                               np.median(a), rtol=1e-6)
+    np.testing.assert_allclose(float(st.percentile(fa, 25.0).glom()),
+                               np.percentile(a, 25.0), rtol=1e-5)
+    # f32 sum of these overflows to inf; median itself is finite
+    b = np.full(8192, 3e37, np.float32)
+    fb = st.from_numpy(b, tiling=tiling.row(1))
+    np.testing.assert_allclose(float(st.median(fb).glom()), 3e37,
+                               rtol=1e-6)
+    # -inf alongside inf: still finite-median, still no poison
+    c = np.arange(128, dtype=np.float32)
+    c[3], c[100] = -np.inf, np.inf
+    fc = st.from_numpy(c, tiling=tiling.row(1))
+    np.testing.assert_allclose(float(st.median(fc).glom()),
+                               np.median(c), rtol=1e-6)
+
+
+def test_percentile_vector_q_message():
+    """Array-valued q gets an explicit NotImplementedError, not an
+    opaque TypeError (round-4 advisor, low)."""
+    a = st.from_numpy(np.arange(16, dtype=np.float32))
+    with pytest.raises(NotImplementedError, match="scalar q"):
+        st.percentile(a, [25.0, 75.0])
