@@ -475,46 +475,111 @@ def cumprod(x, axis: int = 0) -> Expr:
 
 
 def einsum(subscripts: str, *operands, precision=None) -> Expr:
-    """NumPy-style einsum over lazy operands: one traced contraction,
-    sharded by GSPMD from the operands' tilings (the subscripts ride
-    the compile-cache key explicitly)."""
+    """NumPy-style einsum over lazy operands.
+
+    Two-operand contractions (incl. ellipsis batching) build a planned
+    ``ContractExpr`` — the smart-tiling pass searches output grids and
+    contraction placements for them exactly as for 2-D GEMMs
+    (SURVEY.md §2.3 pass (d)). Specs outside that family (3+ operands,
+    diagonals, broadcasting ellipses) stay a single traced ``jnp.einsum``
+    sharded by GSPMD from the operands' tilings."""
+    from .contract import contract, parse_einsum_2op
     from .map2 import map2
 
-    return map2([as_expr(o) for o in operands],
+    exprs = [as_expr(o) for o in operands]
+    if len(exprs) == 2:
+        labels = parse_einsum_2op(subscripts, exprs[0].ndim,
+                                  exprs[1].ndim)
+        if labels is not None:
+            e = contract(exprs[0], exprs[1], *labels,
+                         precision=precision)
+            if e is not None:
+                return e
+    return map2(exprs,
                 lambda *xs, subscripts, precision: jnp.einsum(
                     subscripts, *xs, precision=precision),
                 fn_kw={"subscripts": subscripts, "precision": precision})
 
 
 def tensordot(a, b, axes=2) -> Expr:
-    """NumPy ``tensordot`` (axes spec normalized for cache-key
-    hashability)."""
+    """NumPy ``tensordot``: lowered as a planned contraction (free axes
+    of ``a``, then of ``b``; contracted pairs share labels), so the
+    smart-tiling pass plans it like any GEMM."""
+    from .contract import _CANON, contract
     from .map2 import map2
 
+    a, b = as_expr(a), as_expr(b)
     if isinstance(axes, (list, tuple)):
         ax0, ax1 = axes
-        axes = (tuple(np.atleast_1d(ax0).tolist()),
-                tuple(np.atleast_1d(ax1).tolist()))
+
+        def _norm(xs, nd):
+            out = []
+            for x in np.atleast_1d(xs):
+                x = int(x)
+                if not -nd <= x < nd:
+                    raise ValueError(
+                        f"tensordot axis {x} out of range for "
+                        f"ndim {nd}")
+                out.append(x % nd)
+            return tuple(out)
+
+        ax_a = _norm(ax0, a.ndim)
+        ax_b = _norm(ax1, b.ndim)
+        if len(ax_a) != len(ax_b):
+            raise ValueError(
+                f"tensordot axes lists differ in length: "
+                f"{len(ax_a)} vs {len(ax_b)}")
     else:
-        axes = int(axes)
-    return map2([as_expr(a), as_expr(b)],
+        k = int(axes)
+        ax_a = tuple(range(a.ndim - k, a.ndim))
+        ax_b = tuple(range(k))
+    la = [_CANON[i] for i in range(a.ndim)]
+    lb = [_CANON[a.ndim + i] for i in range(b.ndim)]
+    for i, j in zip(ax_a, ax_b):
+        lb[j] = la[i]
+    out = tuple(la[i] for i in range(a.ndim) if i not in ax_a) + \
+        tuple(lb[j] for j in range(b.ndim) if j not in ax_b)
+    e = contract(a, b, tuple(la), tuple(lb), out)
+    if e is not None:
+        return e
+    axes_n = (ax_a, ax_b)
+    return map2([a, b],
                 lambda x, y, axes: jnp.tensordot(x, y, axes=axes),
-                fn_kw={"axes": axes})
+                fn_kw={"axes": axes_n})
 
 
 def matmul(a, b, precision=None) -> Expr:
     """``a @ b``: 1-D/2-D operands route through the smart-tiling
-    DotExpr; batched (>2-D) operands are a traced ``jnp.matmul``."""
+    DotExpr; batched (>2-D) operands become a planned batched
+    contraction (traced ``jnp.matmul`` only when batch dims need
+    broadcasting)."""
+    from .contract import _CANON, contract
     from .dot import dot as dot_expr
     from .map2 import map2
 
     a, b = as_expr(a), as_expr(b)
     if a.ndim <= 2 and b.ndim <= 2:
         return dot_expr(a, b, precision=precision)
+    e = None
+    if a.ndim >= 2 and b.ndim >= 2:
+        nb = _size_max(a.ndim, b.ndim) - 2
+        batch = [_CANON[i] for i in range(nb)]
+        la = tuple(batch[nb - (a.ndim - 2):]) + (_CANON[nb],
+                                                 _CANON[nb + 1])
+        lb = tuple(batch[nb - (b.ndim - 2):]) + (_CANON[nb + 1],
+                                                 _CANON[nb + 2])
+        out = tuple(batch) + (_CANON[nb], _CANON[nb + 2])
+        e = contract(a, b, la, lb, out, precision=precision)
+    if e is not None:
+        return e
     return map2([a, b],
                 lambda x, y, precision: jnp.matmul(
                     x, y, precision=precision),
                 fn_kw={"precision": precision})
+
+
+def _size_max(a: int, b: int) -> int:
+    return a if a >= b else b
 
 
 def trace(x, offset: int = 0) -> Expr:
@@ -527,12 +592,21 @@ def trace(x, offset: int = 0) -> Expr:
 
 def inner(a, b) -> Expr:
     """NumPy ``inner``: 1-D operands contract (a dot); otherwise the
-    last-axis contraction via a traced einsum."""
+    last-axis contraction as a planned ContractExpr."""
+    from .contract import _CANON, contract
+    from .map2 import map2
+
     a, b = as_expr(a), as_expr(b)
     if a.ndim == 1 and b.ndim == 1:
         return dot(a, b)
-    from .map2 import map2
-
+    if a.ndim >= 1 and b.ndim >= 1:
+        la = tuple(_CANON[i] for i in range(a.ndim - 1)) + ("z",)
+        lb = tuple(_CANON[a.ndim - 1 + i]
+                   for i in range(b.ndim - 1)) + ("z",)
+        out = la[:-1] + lb[:-1]
+        e = contract(a, b, la, lb, out)
+        if e is not None:
+            return e
     return map2([a, b], lambda x, y: jnp.inner(x, y))
 
 

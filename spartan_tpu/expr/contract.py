@@ -1,0 +1,254 @@
+"""General two-operand tensor contraction, smart-tiling-planned.
+
+Parity surface: the reference's einsum/tensordot-style contractions ran
+through its shuffle GEMM machinery only for 2-D dot; everything else was
+local NumPy per tile (SURVEY.md §2.3 builtins). Here the whole
+2-operand contraction family — einsum, tensordot, batched matmul,
+inner — lowers through one planned node so the smart-tiling pass
+(SURVEY.md §2.3 pass (d)) covers it exactly like 2-D GEMMs: candidate
+output grids x contraction placements, FLOP-priced compute, operand
+reshard and psum bytes (tiling_cost.py). The lowering itself is a
+single ``jnp.einsum`` under GSPMD — XLA's dot_general does the actual
+blocking; the plan only places data.
+
+Axis vocabulary (einsum labels):
+  * batch labels — in both operands and the output,
+  * contraction labels — in both operands, not in the output,
+  * free labels — in one operand and the output,
+  * summed labels — in one operand only (locally reduced by XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..array import tiling as tiling_mod
+from ..array.tiling import Tiling
+from ..parallel import mesh as mesh_mod
+from .base import Expr
+
+_CANON = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+class ContractExpr(Expr):
+    """``einsum(a_labels, b_labels -> out_labels)`` over two operands.
+
+    Labels are canonicalized single characters; ``_dot_plan`` (set by
+    the smart-tiling pass, same attribute as DotExpr so the pass
+    commits both uniformly) is ``(output Tiling, strategy)`` where
+    strategy None = gathered contraction and a mesh axis = the largest
+    contraction dim sharded there, merged by an output psum.
+    """
+
+    def __init__(self, a: Expr, b: Expr,
+                 a_labels: Sequence[str], b_labels: Sequence[str],
+                 out_labels: Sequence[str],
+                 precision: Optional[str] = None):
+        self.a = a
+        self.b = b
+        self.a_labels = tuple(a_labels)
+        self.b_labels = tuple(b_labels)
+        self.out_labels = tuple(out_labels)
+        self.precision = precision
+        self._dot_plan = None
+        if len(self.a_labels) != a.ndim or len(self.b_labels) != b.ndim:
+            raise ValueError("labels must cover every operand axis")
+        if len(set(self.a_labels)) != len(self.a_labels) or \
+                len(set(self.b_labels)) != len(self.b_labels):
+            raise ValueError("repeated labels within one operand "
+                             "(diagonals) are not contractions")
+        dims: Dict[str, int] = {}
+        for labels, op in ((self.a_labels, a), (self.b_labels, b)):
+            for lab, d in zip(labels, op.shape):
+                if dims.setdefault(lab, int(d)) != int(d):
+                    raise ValueError(
+                        f"size mismatch for label {lab!r}: "
+                        f"{dims[lab]} vs {d}")
+        for lab in self.out_labels:
+            if lab not in dims:
+                raise ValueError(f"output label {lab!r} not in operands")
+        self._dims = dims
+        shape = tuple(dims[lab] for lab in self.out_labels)
+        super().__init__(shape, np.result_type(a.dtype, b.dtype))
+
+    # -- label classification -------------------------------------------
+
+    @property
+    def contraction_labels(self) -> Tuple[str, ...]:
+        """Labels in both operands but not the output, largest dim
+        first (the planner shards the first one)."""
+        both = [lab for lab in self.a_labels
+                if lab in self.b_labels and lab not in self.out_labels]
+        return tuple(sorted(both, key=lambda s: (-self._dims[s], s)))
+
+    def label_size(self, lab: str) -> int:
+        return self._dims[lab]
+
+    def flops(self) -> float:
+        """2 x (product of every distinct label's size) — the MACs of
+        the contraction counted once (batch x free x contraction)."""
+        f = 2.0
+        for d in self._dims.values():
+            f *= d
+        return f
+
+    # -- plan application -----------------------------------------------
+
+    def plan_operand_tilings(self, out_t: Tiling,
+                             strategy: Optional[str]
+                             ) -> Tuple[Tiling, Tiling]:
+        """Operand layouts implied by an output grid + contraction
+        placement: each operand axis takes the output's mesh axis for
+        its label (batch/free), the strategy axis on the primary
+        contraction label, and None elsewhere."""
+        mesh_of = {lab: ax
+                   for lab, ax in zip(self.out_labels, out_t.axes)}
+        contraction = self.contraction_labels
+        primary = contraction[0] if (contraction and strategy) else None
+
+        def operand(labels: Tuple[str, ...]) -> Tiling:
+            axes = []
+            for lab in labels:
+                if lab == primary:
+                    axes.append(strategy)
+                else:
+                    axes.append(mesh_of.get(lab))
+            return Tiling(axes)
+
+        return operand(self.a_labels), operand(self.b_labels)
+
+    # -- Expr protocol --------------------------------------------------
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.a, self.b)
+
+    def replace_children(self, new_children) -> "ContractExpr":
+        return ContractExpr(new_children[0], new_children[1],
+                            self.a_labels, self.b_labels,
+                            self.out_labels, self.precision)
+
+    def _subscripts(self) -> str:
+        return ("".join(self.a_labels) + "," + "".join(self.b_labels)
+                + "->" + "".join(self.out_labels))
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        av = self.a.lower(env)
+        bv = self.b.lower(env)
+        if self._dot_plan is not None:
+            mesh = mesh_mod.get_mesh()
+            out_t, strategy = self._dot_plan
+            ta, tb = self.plan_operand_tilings(out_t, strategy)
+            av = jax.lax.with_sharding_constraint(av, ta.sharding(mesh))
+            bv = jax.lax.with_sharding_constraint(bv, tb.sharding(mesh))
+        return jnp.einsum(self._subscripts(), av, bv,
+                          precision=self.precision)
+
+    def _sig(self, ctx) -> Tuple:
+        plan = (None if self._dot_plan is None
+                else (self._dot_plan[0].axes, self._dot_plan[1]))
+        return ("contract", self._subscripts(), self.precision, plan,
+                ctx.of(self.a), ctx.of(self.b))
+
+    def _default_tiling(self) -> Tiling:
+        if self.ndim >= 2:
+            return tiling_mod.block(self.ndim)
+        if self.ndim == 1:
+            return tiling_mod.row(1)
+        return Tiling(())
+
+
+def contract(a: Expr, b: Expr, a_labels: Sequence[str],
+             b_labels: Sequence[str], out_labels: Sequence[str],
+             precision: Optional[str] = None) -> Optional[ContractExpr]:
+    """Build a planned contraction, or None when the spec falls outside
+    the contraction family (repeated labels / size mismatches needing
+    broadcast) — callers fall back to a traced einsum then."""
+    try:
+        return ContractExpr(a, b, a_labels, b_labels, out_labels,
+                            precision)
+    except ValueError:
+        return None
+
+
+def canonicalize(per_operand: Sequence[Sequence[str]],
+                 out: Sequence[str]
+                 ) -> Tuple[Tuple[Tuple[str, ...], ...],
+                            Tuple[str, ...]]:
+    """Rename arbitrary axis labels to canonical letters in first-use
+    order — distinct user spellings of the same contraction share one
+    compile-cache entry."""
+    mapping: Dict[str, str] = {}
+
+    def rename(lab: str) -> str:
+        if lab not in mapping:
+            if len(mapping) >= len(_CANON):
+                raise ValueError("too many distinct contraction labels")
+            mapping[lab] = _CANON[len(mapping)]
+        return mapping[lab]
+
+    ops = tuple(tuple(rename(lab) for lab in labels)
+                for labels in per_operand)
+    return ops, tuple(rename(lab) for lab in out)
+
+
+def parse_einsum_2op(subscripts: str, a_ndim: int, b_ndim: int
+                     ) -> Optional[Tuple[Tuple[str, ...],
+                                         Tuple[str, ...],
+                                         Tuple[str, ...]]]:
+    """Parse a two-operand einsum spec into canonical per-axis label
+    tuples, expanding ellipses against the known ranks. Returns None
+    for specs outside the planned family (the caller's traced-einsum
+    fallback handles those): repeated labels in an operand, or
+    ellipsis batch ranks that differ between operands or broadcast."""
+    spec = subscripts.replace(" ", "")
+    if "->" in spec:
+        ins, out = spec.split("->", 1)
+    else:
+        ins, out = spec, None
+    parts = ins.split(",")
+    if len(parts) != 2:
+        return None
+
+    def expand(part: str, ndim: int) -> Optional[Tuple[str, ...]]:
+        if "..." in part:
+            head, _, tail = part.partition("...")
+            n_ell = ndim - len(head) - len(tail)
+            if n_ell < 0:
+                return None
+            ell = tuple(f"...{i}" for i in range(n_ell))
+            return tuple(head) + ell + tuple(tail)
+        return tuple(part) if len(part) == ndim else None
+
+    la = expand(parts[0], a_ndim)
+    lb = expand(parts[1], b_ndim)
+    if la is None or lb is None:
+        return None
+    n_ell_a = len([x for x in la if x.startswith("...")])
+    n_ell_b = len([x for x in lb if x.startswith("...")])
+    if n_ell_a and n_ell_b and n_ell_a != n_ell_b:
+        return None  # broadcasting ellipsis ranks: traced fallback
+    ell = [x for x in (la if n_ell_a >= n_ell_b else lb)
+           if x.startswith("...")]
+    if out is None:
+        # implicit output: ellipsis dims then once-occurring labels in
+        # alphabetical order (NumPy's rule)
+        counts: Dict[str, int] = {}
+        for lab in tuple(parts[0].replace(".", "")) + \
+                tuple(parts[1].replace(".", "")):
+            counts[lab] = counts.get(lab, 0) + 1
+        lo = tuple(ell) + tuple(sorted(
+            lab for lab, c in counts.items() if c == 1))
+    else:
+        if "..." in out:
+            head, _, tail = out.partition("...")
+            lo = tuple(head) + tuple(ell) + tuple(tail)
+        else:
+            if ell:
+                return None  # einsum would error; let jnp raise it
+            lo = tuple(out)
+    (ca, cb), co = canonicalize((la, lb), lo)
+    return ca, cb, co

@@ -35,28 +35,39 @@ from .reshape import TransposeExpr
 from .slice import SliceExpr
 
 # Bytes-equivalent weight of local compute relative to interconnect
-# bytes. 4.0 is a HAND-CHOSEN default: the CPU-mesh measurement
-# (calibrate_compute_weight, recorded as ~0.9 in
-# benchmarks/tiling_sweep.json) produced worse plan picks when applied
-# directly — the model's compute term scales with output bytes, not
-# FLOPs, so the measured ratio at one shape does not transfer. Override
-# per-platform with --tiling_compute_weight after validating with the
-# --sweep.
+# bytes — applied to OUTPUT BYTES of non-contraction nodes only, where
+# elementwise work is memory-bound and output bytes are the right
+# proxy (~2 reads + 1 write of HBM per output byte, plus epsilon ALU).
+# Contractions are priced by FLOPs instead (_flop_weight below) — the
+# round-4 model priced GEMM compute by output bytes too, which is
+# dimensionally wrong (a 2mnk contraction's cost grows with k at fixed
+# output size) and forced a hand-chosen override here.
 _COMPUTE_WEIGHT = 4.0
 
-# Weight on operand-reshard bytes in GEMM plans, relative to output
-# psum bytes. Operand gathers sit on the critical path BEFORE the
-# matmul and replicate operand memory, while the output all-reduce
+# Bytes-equivalent cost of one contraction FLOP: (sec/FLOP) divided by
+# (sec/interconnect-byte). Measured by calibrate_flop_weight — a local
+# matmul timed against a ring all-gather on the same mesh — and
+# recorded per platform; the cpu value is the committed calibration
+# from benchmarks/tiling_sweep.json (regenerate with tiling_ab.py
+# --sweep), the tpu value derives from spec ratios (~200 bf16 TFLOP/s
+# MXU vs ~4.5e10 B/s ICI per link) pending on-pod calibration.
+# Override with --tiling_flop_weight.
+_FLOP_WEIGHT_DEFAULTS = {"cpu": 0.005, "tpu": 2.5e-4}
+_FLOP_WEIGHT_FALLBACK = 1e-3
+
+# Weight on operand-reshard bytes in contraction plans, relative to
+# output psum bytes. Operand gathers sit on the critical path BEFORE
+# the matmul and replicate operand memory, while the output all-reduce
 # pipelines with the epilogue — so a byte of operand movement costs
 # more wall time than a byte of psum. CALIBRATED by the measured-arm
-# sweep (benchmarks/tiling_ab.py --sweep, 8 layout combos x all
-# candidate plans on the 8-device CPU mesh): with weight 1 the model
-# picked gathered plans measuring up to 2.2x slower than the best
-# psum arm (col x row combo); weight 2 brings every combo's pick
-# within 20% of the best measured arm EXCEPT row_t x row_t (1.25x —
-# the known residual documented in tiling_sweep.json's notes).
+# sweep (benchmarks/tiling_ab.py --sweep, 8 GEMM layout combos + 2
+# einsum batched-matmul combos x all candidate plans on the 8-device
+# CPU mesh): under receive-bytes reshard pricing, weights 4 and 5 both
+# bring EVERY combo's pick within 20% of its best measured arm
+# (including row_t x row_t, the round-4 residual — now 1.00); 5
+# measured best overall (max pick/best 1.145, within run noise).
 # Override with --tiling_operand_move_weight.
-_OPERAND_MOVE_WEIGHT = 2.0
+_OPERAND_MOVE_WEIGHT = 5.0
 
 # Tie-break epsilon on the same quantity: keeps plan choice
 # deterministic on exact byte ties regardless of the weight above.
@@ -118,30 +129,32 @@ def _axis_size(mesh, ax) -> int:
 
 
 def reshard_cost(src: Tiling, dst: Tiling, nbytes: float, mesh) -> float:
-    """Per-chip bytes to move from ``src`` to ``dst`` layout.
+    """Per-chip RECEIVE bytes to move from ``src`` to ``dst`` layout.
 
-    Axis-wise: refining an unsharded axis (None -> mesh axis) is a
-    local slice (0 bytes); coarsening (mesh axis -> None) all-gathers
-    over that axis; moving an axis to a *different* mesh axis is an
-    all-to-all over the involved devices."""
+    Each chip ends holding ``nbytes / p_dst`` and already holds the
+    expected overlap between its source shard and its destination
+    shard; the difference is what the interconnect must deliver.
+    Per-axis overlap fractions: an axis sharded by the SAME mesh axis
+    on both sides is fully aligned (fraction = per-axis dst share); an
+    axis whose split changed contributes the product of both shares
+    (aligned-grid expected intersection). This prices partial
+    replication correctly — e.g. a (y, None) -> (x, y) redistribute of
+    a matrix replicated over x receives nbytes/16 per chip, not the
+    full-mesh all-to-all the round-4 model charged (the source of its
+    documented row_t x row_t mispick)."""
     if src.axes == dst.axes:
         return 0.0
-    if not src.sharded_axes():  # replicated source: local slicing only
-        return 0.0
-    cost = 0.0
-    a2a = False
+    dst_frac = 1.0
+    local_frac = 1.0
     for s_ax, d_ax in zip(src.axes, dst.axes):
-        if s_ax == d_ax or s_ax is None:
-            continue
-        if d_ax is None:
-            n = _axis_size(mesh, s_ax)
-            cost += nbytes * (n - 1) / max(n, 1)
+        s = _axis_size(mesh, s_ax)
+        d = _axis_size(mesh, d_ax)
+        dst_frac /= d
+        if s_ax == d_ax:
+            local_frac /= d
         else:
-            a2a = True
-    if a2a:
-        n = _mesh_n(mesh)
-        cost = max(cost, nbytes * (n - 1) / max(n, 1))
-    return cost
+            local_frac /= s * d
+    return nbytes * max(0.0, dst_frac - local_frac)
 
 
 def _operand_requirement(node: Expr, t: Tiling, child: Expr,
@@ -174,10 +187,11 @@ def _operand_requirement(node: Expr, t: Tiling, child: Expr,
 
 
 def _dot_strategies(t: Tiling, mesh) -> List[Optional[str]]:
-    """Contraction placements for a GEMM with output grid (m_r, m_c):
-    None = contraction replicated (gathered operands); a mesh axis =
-    contraction sharded there, merged by an output psum."""
-    used = {a for a in t.axes[:2] if a is not None}
+    """Contraction placements for an output grid: None = contraction
+    replicated (gathered operands); a mesh axis = contraction sharded
+    there, merged by an output psum. Only axes the output grid does
+    not already use are available."""
+    used = {a for a in t.axes if a is not None}
     out: List[Optional[str]] = [None]
     for ax in mesh.axis_names:
         if ax not in used and mesh.shape.get(ax, 1) > 1:
@@ -185,11 +199,47 @@ def _dot_strategies(t: Tiling, mesh) -> List[Optional[str]]:
     return out
 
 
+def _contraction_view(node: Expr):
+    """``(flops, reqs_fn)`` for nodes the planner strategy-searches —
+    2-D DotExpr GEMMs and every ContractExpr (einsum / tensordot /
+    batched matmul / inner). ``reqs_fn(t, s)`` maps an output grid +
+    contraction placement to the two operand tilings the lowering will
+    constrain; None for non-contraction nodes."""
+    from .contract import ContractExpr
+    from .dot import DotExpr
+
+    if isinstance(node, DotExpr) and node.a.ndim == 2 \
+            and node.b.ndim == 2:
+        m, k = node.a.shape
+        n = node.b.shape[1]
+
+        def reqs(t: Tiling, s: Optional[str]):
+            return Tiling((t.axes[0], s)), Tiling((s, t.axes[1]))
+
+        return 2.0 * m * k * n, reqs, True
+    if isinstance(node, ContractExpr):
+        return (node.flops(), node.plan_operand_tilings,
+                bool(node.contraction_labels))
+    return None
+
+
 def _compute_weight() -> float:
     from ..utils.config import FLAGS
 
     w = float(getattr(FLAGS, "tiling_compute_weight", 0.0) or 0.0)
     return w if w > 0 else _COMPUTE_WEIGHT
+
+
+def _flop_weight() -> float:
+    from ..utils.config import FLAGS
+
+    w = float(getattr(FLAGS, "tiling_flop_weight", 0.0) or 0.0)
+    if w > 0:
+        return w
+    import jax
+
+    return _FLOP_WEIGHT_DEFAULTS.get(jax.default_backend(),
+                                     _FLOP_WEIGHT_FALLBACK)
 
 
 def _operand_move_weight() -> float:
@@ -203,10 +253,9 @@ def _build_table(root: Expr, mesh) -> Dict:
     """Bottom-up candidate cost table:
     ``table[node_id][tiling] = (cost, per-child picks, strategy)``
     where strategy is the chosen contraction placement for GEMMs."""
-    from .dot import DotExpr
-
     table: Dict[int, Dict[Tiling, Tuple[float, Tuple, Optional[str]]]] = {}
     weight = _compute_weight()
+    flop_w = _flop_weight()
     move_w = _operand_move_weight()
 
     def nbytes(e: Expr) -> float:
@@ -245,37 +294,42 @@ def _build_table(root: Expr, mesh) -> Dict:
             table[node._id] = entries
             return
         kids = node.children()
-        is_gemm = (isinstance(node, DotExpr)
-                   and node.a.ndim == 2 and node.b.ndim == 2)
+        cview = _contraction_view(node)
         for t in candidates(node, mesh):
             compute = (nbytes(node) * weight
                        / _parallelism(t, mesh))
-            if is_gemm:
-                # search contraction strategies: operand layouts are
-                # A (m_r, k), B (k, m_c); k=None gathers the
-                # contraction, k=mesh-axis shards it and pays an
-                # output psum — mirroring DotExpr._lower exactly.
-                # A sharded contraction multiplies the compute
-                # parallelism: the FLOPs spread over output grid x k.
-                m_r, m_c = t.axes[0], t.axes[1]
+            if cview is not None:
+                # search contraction strategies: s=None gathers the
+                # contraction onto the output grid, s=mesh-axis shards
+                # it there and pays an output psum — reqs_fn mirrors
+                # the node's _lower exactly. Compute is FLOP-priced
+                # (2mnk-style, _flop_weight): a sharded contraction
+                # multiplies the parallelism by the strategy axis.
+                flops, reqs_fn, has_contraction = cview
                 best = None
-                for s in _dot_strategies(t, mesh):
-                    ca, pa, ma = best_child(kids[0], Tiling((m_r, s)),
-                                            move_w)
-                    cb, pb, mb = best_child(kids[1], Tiling((s, m_c)),
-                                            move_w)
+                strategies = (_dot_strategies(t, mesh)
+                              if has_contraction else [None])
+                for s in strategies:
+                    req_a, req_b = reqs_fn(t, s)
+                    ca, pa, ma = best_child(kids[0], req_a, move_w)
+                    cb, pb, mb = best_child(kids[1], req_b, move_w)
                     psum = 0.0
                     if s is not None:
+                        # ring all-reduce of each chip's PARTIAL — the
+                        # output shard under grid t, not the full
+                        # array: reduce-scatter + all-gather moves
+                        # ~2 x shard x (ns-1)/ns per chip
                         ns = _axis_size(mesh, s)
-                        psum = nbytes(node) * (ns - 1) / ns
-                    flops = (nbytes(node) * weight
-                             / (_parallelism(t, mesh)
-                                * _axis_size(mesh, s)))
+                        psum = (2.0 * nbytes(node)
+                                / _parallelism(t, mesh)
+                                * (ns - 1) / ns)
+                    fl = (flops * flop_w
+                          / (_parallelism(t, mesh) * _axis_size(mesh, s)))
                     # operand movement is charged at move_w inside
                     # best_child (critical path before the matmul —
                     # see _OPERAND_MOVE_WEIGHT); the epsilon keeps
                     # exact ties deterministic
-                    tot = (ca + cb + psum + flops
+                    tot = (ca + cb + psum + fl
                            + (ma + mb) * _OP_MOVE_EPS)
                     if best is None or tot < best[0]:
                         best = (tot, (pa, pb), s)
@@ -298,6 +352,7 @@ def _build_table(root: Expr, mesh) -> Dict:
 
 
 def assign_tilings(root: Expr) -> Expr:
+    from .contract import ContractExpr
     from .dot import DotExpr, DotShardMapExpr
 
     mesh = mesh_mod.get_mesh()
@@ -323,9 +378,11 @@ def assign_tilings(root: Expr) -> Expr:
         # without forcing a redundant *output* constraint when the
         # chosen grid equals the default.
         strategy = entry[2] if entry is not None else None
-        is_gemm = isinstance(node, (DotExpr, DotShardMapExpr))
-        plans_operands = (isinstance(node, DotExpr)
-                          and node.a.ndim == 2 and node.b.ndim == 2)
+        is_gemm = isinstance(node, (DotExpr, DotShardMapExpr,
+                                    ContractExpr))
+        plans_operands = (isinstance(node, ContractExpr)
+                          or (isinstance(node, DotExpr)
+                              and node.a.ndim == 2 and node.b.ndim == 2))
         nondefault = t is not None and t != node._default_tiling()
         if plans_operands:
             # first visit wins (diamond DAGs); the forced output — when
@@ -352,12 +409,12 @@ def assign_tilings(root: Expr) -> Expr:
 
 def gemm_plan_costs(root: Expr) -> Dict:
     """Candidate ``(output tiling, strategy, model cost)`` lists for
-    every 2-D GEMM node in ``root`` — the validation surface for the
-    cost model (benchmarks/tiling_ab.py --sweep and
+    every planned contraction node in ``root`` (2-D GEMMs and
+    ContractExpr einsum/tensordot/batched-matmul) — the validation
+    surface for the cost model (benchmarks/tiling_ab.py --sweep and
     tests/test_tiling_calibration.py force each candidate as a
     measured arm and compare the model's ranking against wall time).
-    Returns ``{DotExpr node: [(Tiling, strategy, cost), ...]}``."""
-    from .dot import DotExpr
+    Returns ``{node: [(Tiling, strategy, cost), ...]}``."""
     from .optimize import dag_nodes
 
     mesh = mesh_mod.get_mesh()
@@ -366,25 +423,26 @@ def gemm_plan_costs(root: Expr) -> Dict:
     table = _build_table(root, mesh)
     out = {}
     for n in dag_nodes(root):
-        if (isinstance(n, DotExpr) and n.a.ndim == 2 and n.b.ndim == 2
-                and n._id in table):
+        if _contraction_view(n) is not None and n._id in table:
             out[n] = sorted(
                 ((t, e[2], e[0]) for t, e in table[n._id].items()),
                 key=lambda x: x[2])
     return out
 
 
-def calibrate_compute_weight(n: int = 512, iters: int = 5,
-                             mesh=None) -> float:
-    """Measure the compute weight on the current backend.
+def calibrate_flop_weight(n: int = 512, iters: int = 5,
+                          mesh=None) -> float:
+    """Measure the bytes-equivalent cost of one FLOP on this backend.
 
-    The model prices a replicated GEMM's compute at ``nbytes * C`` and
-    a full all-gather at ``nbytes * (p-1)/p``; calibrating C so those
-    two ratios match the measured single-device matmul time vs the
-    measured all-gather time makes the model's compute/communication
-    trade-off empirical instead of guessed:
-    ``C = (t_matmul / t_allgather) * (p - 1) / p``.
-    Record per-platform values via ``--tiling_compute_weight``."""
+    Times a single-device ``n x n`` matmul (``2n^3`` FLOPs) against a
+    row->replicated all-gather of the same matrix (``n^2 * itemsize *
+    (p-1)/p`` per-chip bytes) and returns
+    ``(t_mm / flops) / (t_ag / bytes)`` — seconds-per-FLOP over
+    seconds-per-interconnect-byte, exactly the units the contraction
+    compute term multiplies by 2mnk. Dimensionally consistent, so one
+    calibration transfers across shapes (unlike the round-4
+    output-bytes weight, which baked n into the constant). Record
+    per-platform values via ``--tiling_flop_weight``."""
     import time as _time
 
     import jax
@@ -393,7 +451,7 @@ def calibrate_compute_weight(n: int = 512, iters: int = 5,
     mesh = mesh or mesh_mod.get_mesh()
     p = _mesh_n(mesh)
     if p <= 1:
-        return _COMPUTE_WEIGHT
+        return _flop_weight()
     x = jnp.asarray(np.random.RandomState(0).rand(n, n).astype(np.float32))
     mm = jax.jit(lambda a: a @ a)
     jax.block_until_ready(mm(x))
@@ -412,8 +470,10 @@ def calibrate_compute_weight(n: int = 512, iters: int = 5,
         jax.block_until_ready(gather(xs))
     t_ag = (_time.perf_counter() - t0) / iters
     if t_ag <= 0:
-        return _COMPUTE_WEIGHT
-    return float(t_mm / t_ag * (p - 1) / p)
+        return _flop_weight()
+    flops = 2.0 * n * n * n
+    ag_bytes = float(n) * n * x.dtype.itemsize * (p - 1) / p
+    return float((t_mm / flops) / (t_ag / ag_bytes))
 
 
 def explain(root: Expr) -> str:

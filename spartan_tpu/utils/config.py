@@ -153,9 +153,13 @@ FLAGS.define_bool("opt_auto_tiling", True,
                   "Smart-tiling pass: pick shardings via the cost model.")
 FLAGS.define_float(
     "tiling_compute_weight", 0.0,
-    "Compute-vs-communication weight for the smart-tiling cost model "
-    "(0 = built-in default; calibrate with "
-    "tiling_cost.calibrate_compute_weight).")
+    "Bytes-priced compute weight for NON-contraction nodes in the "
+    "smart-tiling cost model (0 = built-in default).")
+FLAGS.define_float(
+    "tiling_flop_weight", 0.0,
+    "Bytes-equivalent cost of one contraction FLOP in the smart-tiling "
+    "cost model (0 = per-platform default; calibrate with "
+    "tiling_cost.calibrate_flop_weight).")
 FLAGS.define_float(
     "tiling_operand_move_weight", 0.0,
     "Weight on GEMM operand-reshard bytes vs output-psum bytes in the "

@@ -1,0 +1,217 @@
+"""ContractExpr: the planned einsum/tensordot/batched-matmul family
+(round-4 verdict #1 — the smart-tiling pass must cover the whole
+contraction surface, not just 2-D DotExpr GEMMs)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling
+from spartan_tpu.expr.contract import (ContractExpr, canonicalize,
+                                       parse_einsum_2op)
+from spartan_tpu.expr.map2 import Map2Expr
+from spartan_tpu.expr.optimize import dag_nodes
+from spartan_tpu.expr.tiling_cost import gemm_plan_costs
+from spartan_tpu.utils.config import FLAGS
+
+
+@pytest.fixture(autouse=True)
+def _flags():
+    yield
+    FLAGS.reset_all()
+
+
+def _rand(*shape):
+    return np.random.RandomState(sum(shape)).rand(*shape).astype(
+        np.float32)
+
+
+def test_einsum_2op_is_planned(mesh2d):
+    a, b = _rand(16, 32, 24), _rand(16, 24, 40)
+    e = st.einsum("bij,bjk->bik", st.from_numpy(a), st.from_numpy(b))
+    assert isinstance(e, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.einsum("bij,bjk->bik", a, b),
+                               rtol=1e-4)
+
+
+def test_einsum_ellipsis_and_implicit(mesh2d):
+    a, b = _rand(16, 32, 24), _rand(16, 24, 40)
+    e = st.einsum("...ij,...jk->...ik", st.from_numpy(a),
+                  st.from_numpy(b))
+    assert isinstance(e, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()), a @ b, rtol=1e-4)
+    c, d = _rand(12, 24), _rand(24, 8)
+    e2 = st.einsum("ij,jk", st.from_numpy(c), st.from_numpy(d))
+    assert isinstance(e2, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e2.glom()),
+                               np.einsum("ij,jk", c, d), rtol=1e-4)
+
+
+def test_einsum_fallbacks_stay_correct(mesh2d):
+    """Specs outside the planned family (diagonals, 3+ operands,
+    broadcasting) fall back to the traced einsum, bit-identical in
+    semantics."""
+    eye = np.eye(24, dtype=np.float32)
+    c = _rand(24, 12)
+    e = st.einsum("ii,ij->j", st.from_numpy(eye), st.from_numpy(c))
+    assert isinstance(e, Map2Expr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.einsum("ii,ij->j", eye, c), rtol=1e-4)
+    d = _rand(12, 24)
+    e3 = st.einsum("ij,jk,kl->il", st.from_numpy(d), st.from_numpy(c),
+                   st.from_numpy(d))
+    assert isinstance(e3, Map2Expr)
+    np.testing.assert_allclose(np.asarray(e3.glom()), d @ c @ d,
+                               rtol=1e-4)
+    # broadcasting batch (1 vs 16): traced fallback handles it
+    a1 = _rand(1, 8, 8)
+    b16 = _rand(16, 8, 8)
+    e4 = st.einsum("bij,bjk->bik", st.from_numpy(a1), st.from_numpy(b16))
+    assert isinstance(e4, Map2Expr)
+    np.testing.assert_allclose(np.asarray(e4.glom()),
+                               np.einsum("bij,bjk->bik", a1, b16),
+                               rtol=1e-4)
+
+
+def test_tensordot_planned(mesh2d):
+    a, b = _rand(6, 8, 24), _rand(24, 10)
+    e = st.tensordot(st.from_numpy(a), st.from_numpy(b),
+                     axes=[[2], [0]])
+    assert isinstance(e, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.tensordot(a, b, axes=[[2], [0]]),
+                               rtol=1e-4)
+    # scalar axes form
+    c = _rand(8, 24, 10)
+    e2 = st.tensordot(st.from_numpy(a), st.from_numpy(c), axes=2)
+    assert isinstance(e2, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e2.glom()),
+                               np.tensordot(a, c, axes=2), rtol=1e-4)
+
+
+def test_batched_matmul_planned(mesh2d):
+    a, b = _rand(8, 16, 24), _rand(8, 24, 12)
+    e = st.matmul(st.from_numpy(a), st.from_numpy(b))
+    assert isinstance(e, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()), a @ b, rtol=1e-4)
+    # rank-mismatched (broadcast of the 2-D operand over batch)
+    c = _rand(24, 12)
+    e2 = st.matmul(st.from_numpy(a), st.from_numpy(c))
+    assert isinstance(e2, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e2.glom()), a @ c, rtol=1e-4)
+
+
+def test_inner_planned(mesh2d):
+    a, b = _rand(12, 24), _rand(8, 24)
+    e = st.inner(st.from_numpy(a), st.from_numpy(b))
+    assert isinstance(e, ContractExpr)
+    np.testing.assert_allclose(np.asarray(e.glom()), np.inner(a, b),
+                               rtol=1e-4)
+
+
+def test_planner_sees_contract_nodes(mesh2d):
+    """gemm_plan_costs reports candidate plans for einsum nodes —
+    the round-4 gap (planner scope froze at 2-D DotExpr)."""
+    a, b = _rand(8, 64, 64), _rand(8, 64, 64)
+    probe = st.einsum("bij,bjk->bik", st.from_numpy(a),
+                      st.from_numpy(b)).optimized()
+    plans = gemm_plan_costs(probe)
+    nodes = [n for n in plans if isinstance(n, ContractExpr)]
+    assert len(nodes) == 1
+    arms = plans[nodes[0]]
+    assert len(arms) > 1
+    # at least one candidate shards the contraction (psum strategy)
+    assert any(s is not None for _, s, _ in arms)
+
+
+def test_planner_changes_einsum_sharding(mesh2d):
+    """The pass observably changes the einsum's lowering vs the
+    ablation-off arm: a plan (operand constraints + psum strategy) is
+    recorded with the pass on, absent with it off; results identical."""
+    a, b = _rand(8, 64, 64), _rand(8, 64, 64)
+
+    def build():
+        return st.einsum("bij,bjk->bik", st.from_numpy(a),
+                         st.from_numpy(b))
+
+    FLAGS.opt_auto_tiling = True
+    e_on = build().optimized()
+    on_nodes = [n for n in dag_nodes(e_on)
+                if isinstance(n, ContractExpr)]
+    assert on_nodes and on_nodes[0]._dot_plan is not None
+    # the plan reaches the compile-cache key (changed lowering)
+    FLAGS.opt_auto_tiling = False
+    e_off = build().optimized()
+    off_nodes = [n for n in dag_nodes(e_off)
+                 if isinstance(n, ContractExpr)]
+    assert off_nodes and off_nodes[0]._dot_plan is None
+    np.testing.assert_allclose(np.asarray(e_on.glom()),
+                               np.asarray(e_off.glom()), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(e_off.glom()), a @ b,
+                               rtol=1e-4)
+
+
+def test_forced_plan_obeyed_end_to_end(mesh2d):
+    """Every candidate plan of a batched matmul evaluates to the
+    oracle — forced operand shardings and psum strategies are
+    semantically free."""
+    a, b = _rand(8, 32, 32), _rand(8, 32, 32)
+    FLAGS.opt_auto_tiling = False
+    ref = a @ b
+    probe = st.einsum("bij,bjk->bik", st.from_numpy(a),
+                      st.from_numpy(b)).optimized()
+    (node, arms), = gemm_plan_costs(probe).items()
+    for t, s, _cost in arms:
+        e = st.einsum("bij,bjk->bik", st.from_numpy(a),
+                      st.from_numpy(b)).optimized()
+        d = [x for x in dag_nodes(e) if isinstance(x, ContractExpr)][0]
+        d._dot_plan = (t, s)
+        if t != d._default_tiling():
+            d._forced_tiling = t
+        np.testing.assert_allclose(np.asarray(e.glom()), ref,
+                                   rtol=1e-4)
+
+
+def test_tensordot_rejects_bad_axes(mesh2d):
+    """Mismatched axes-list lengths and out-of-range axes raise, like
+    numpy — not a silently wrong reduction (round-5 review)."""
+    a = st.from_numpy(_rand(4, 5, 6))
+    b = st.from_numpy(_rand(5, 7))
+    with pytest.raises(ValueError, match="differ in length"):
+        st.tensordot(a, b, axes=[[1, 2], [0]])
+    with pytest.raises(ValueError, match="out of range"):
+        st.tensordot(a, b, axes=[[4], [0]])
+    # negative axes still wrap, numpy-style
+    e = st.tensordot(a, b, axes=[[-2], [0]])
+    an, bn = _rand(4, 5, 6), _rand(5, 7)
+    np.testing.assert_allclose(np.asarray(e.glom()),
+                               np.tensordot(an, bn, axes=[[-2], [0]]),
+                               rtol=1e-4)
+
+
+def test_parse_einsum_2op():
+    assert parse_einsum_2op("ij,jk->ik", 2, 2) == \
+        (("a", "b"), ("b", "c"), ("a", "c"))
+    # ellipsis expansion against known ranks
+    la, lb, lo = parse_einsum_2op("...ij,...jk->...ik", 3, 3)
+    assert len(la) == len(lb) == len(lo) == 3
+    # implicit output: alphabetical once-occurring labels
+    assert parse_einsum_2op("ij,jk", 2, 2)[2] == ("a", "c")
+    # 3 operands / rank mismatch: not in family
+    assert parse_einsum_2op("ij,jk,kl->il", 2, 2) is None
+    assert parse_einsum_2op("ij,jk->ik", 3, 2) is None
+
+
+def test_canonicalize_shares_cache_key():
+    (a1, b1), o1 = canonicalize((("p", "q"), ("q", "r")), ("p", "r"))
+    (a2, b2), o2 = canonicalize((("i", "j"), ("j", "k")), ("i", "k"))
+    assert (a1, b1, o1) == (a2, b2, o2)
+
+
+def test_contract_flops_and_labels():
+    a = st.from_numpy(_rand(4, 8, 16))
+    b = st.from_numpy(_rand(4, 16, 32))
+    e = st.einsum("bij,bjk->bik", a, b)
+    assert e.contraction_labels == ("c",)  # j canonicalized to c
+    assert e.flops() == 2.0 * 4 * 8 * 16 * 32
