@@ -285,73 +285,126 @@ def size(x) -> int:
 
 
 class SampleSortExpr(Expr):
-    """Distributed 1-D sample sort (SURVEY.md §2.3 misc ops: the
+    """Distributed sample sort (SURVEY.md §2.3 misc ops: the
     reference's sampling-based distributed sort). Lowers to the
     static-shape shard_map program in ``ops/sort.py``: local sort,
     gathered splitter samples, all_to_all bucket exchange, local
-    merge, all_to_all rebalance to even row shards. With
-    ``indices=True`` it is the distributed argsort (global source
-    indices ride the pipeline as a sort payload)."""
+    merge, all_to_all rebalance to even row shards. Any length (a
+    validity channel carries ragged tails); N-d arrays sort along
+    ``axis`` with the 1-D kernel vmapped over the other axes — the
+    sharded sort axis is never gathered. With ``indices=True`` it is
+    the distributed argsort (source indices ride the pipeline as a
+    sort payload)."""
 
-    def __init__(self, x: Expr, indices: bool = False):
+    def __init__(self, x: Expr, indices: bool = False, axis: int = -1):
         self.x = x
         self.indices = indices
+        self.axis = _checked_axis(axis, x.ndim)
         super().__init__(x.shape, np.int32 if indices else x.dtype)
 
     def children(self):
         return (self.x,)
 
     def replace_children(self, new_children) -> "SampleSortExpr":
-        return SampleSortExpr(new_children[0], self.indices)
+        return SampleSortExpr(new_children[0], self.indices, self.axis)
+
+    def _moved_in_tiling(self):
+        """The operand's tiling with the sort axis moved last — what
+        the lowering's moveaxis produces; lets the kernel keep batch
+        shardings and follow the sort axis's existing placement."""
+        t = self.x.out_tiling()
+        axes = list(t.axes)
+        axes.append(axes.pop(self.axis))
+        from ..array.tiling import Tiling
+
+        return Tiling(axes)
 
     def _lower(self, env) -> Any:
         from ..ops import sort as sort_ops
 
-        fn = (sort_ops.sample_argsort if self.indices
-              else sort_ops.sample_sort)
-        return fn(self.x.lower(env))
+        v = self.x.lower(env)
+        if self.x.ndim <= 1:
+            fn = (sort_ops.sample_argsort if self.indices
+                  else sort_ops.sample_sort)
+            return fn(v)
+        last = self.x.ndim - 1
+        if self.axis != last:
+            v = jnp.moveaxis(v, self.axis, last)
+        out = sort_ops.sample_sort_axis(
+            v, with_indices=self.indices,
+            in_tiling=self._moved_in_tiling())
+        if self.axis != last:
+            out = jnp.moveaxis(out, last, self.axis)
+        return out
 
     def _sig(self, ctx):
-        return ("sample_sort", self.indices, ctx.of(self.x))
+        return ("sample_sort", self.indices, self.axis, ctx.of(self.x))
 
     def _default_tiling(self):
         from ..array import tiling as tiling_mod
 
-        return tiling_mod.row(1)
+        if self.ndim <= 1:
+            return tiling_mod.row(1)
+        # batch axes keep the operand's shardings; the sort axis comes
+        # back sharded where the kernel ran it (see ops/sort.py _run)
+        moved = self._moved_in_tiling()
+        name = moved.axes[-1] if isinstance(moved.axes[-1], str) \
+            else tiling_mod.AXIS_ROW
+        axes = [None if a == name else a for a in moved.axes[:-1]]
+        axes.insert(self.axis, name)
+        return tiling_mod.Tiling(axes)
+
+
+def _checked_axis(axis: int, ndim: int) -> int:
+    nd = ndim if ndim else 1
+    if not -nd <= axis < nd:
+        raise ValueError(
+            f"sort axis {axis} out of range for ndim {ndim}")
+    return axis % nd
 
 
 def _distributed_sortable(x: Expr, axis: int) -> bool:
+    """True when the distributed sample sort beats the traced
+    ``jnp.sort``: a multi-device row axis, and (for N-d operands) the
+    sort axis actually sharded — an unsharded sort axis sorts locally
+    under GSPMD with zero communication, which no collective pipeline
+    can beat."""
     from ..array import tiling as tiling_mod
     from ..parallel import mesh as mesh_mod
 
-    if x.ndim != 1 or axis not in (-1, 0):
-        return False
     p = int(mesh_mod.get_mesh().shape.get(tiling_mod.AXIS_ROW, 1))
-    return p > 1 and x.shape[0] % p == 0
+    if p <= 1 or x.ndim == 0 or x.size == 0:
+        return False
+    if x.ndim == 1:
+        return True
+    return x.out_tiling().axes[axis % x.ndim] is not None
 
 
 def sort(x, axis: int = -1) -> Expr:
     """Sorted copy along an axis.
 
-    1-D arrays on a multi-device mesh (with the row axis dividing n)
-    run the distributed sample sort — splitter sampling + all_to_all
-    bucket exchange under shard_map (ops/sort.py), the reference's
-    algorithm in collective form. Everything else is a single traced
-    ``jnp.sort`` over the sharded operand (XLA bitonic sort; fine when
-    the sort axis is unsharded)."""
+    Arrays sharded along the sort axis on a multi-device mesh run the
+    distributed sample sort — splitter sampling + all_to_all bucket
+    exchange under shard_map (ops/sort.py), the reference's algorithm
+    in collective form; any length (ragged tails ride a validity
+    channel) and any rank (the kernel vmaps over non-sort axes).
+    Everything else is a single traced ``jnp.sort`` over the sharded
+    operand (XLA bitonic sort; right when the sort axis is local)."""
     x = as_expr(x)
-    if _distributed_sortable(x, axis):
-        return SampleSortExpr(x)
-    return map_expr(lambda v: jnp.sort(v, axis=axis), x)
+    ax = _checked_axis(axis, x.ndim)
+    if _distributed_sortable(x, ax):
+        return SampleSortExpr(x, axis=ax)
+    return map_expr(lambda v: jnp.sort(v, axis=ax), x)
 
 
 def argsort(x, axis: int = -1) -> Expr:
-    """Indices that sort ``x``; 1-D multi-device arrays run the
-    distributed sample argsort (see :func:`sort`)."""
+    """Indices that sort ``x``; arrays sharded along the sort axis run
+    the distributed sample argsort (see :func:`sort`)."""
     x = as_expr(x)
-    if _distributed_sortable(x, axis):
-        return SampleSortExpr(x, indices=True)
-    return map_expr(lambda v: jnp.argsort(v, axis=axis), x)
+    ax = _checked_axis(axis, x.ndim)
+    if _distributed_sortable(x, ax):
+        return SampleSortExpr(x, indices=True, axis=ax)
+    return map_expr(lambda v: jnp.argsort(v, axis=ax), x)
 
 
 def _nan_poison(x: Expr, rdt) -> Any:
@@ -389,32 +442,44 @@ def median(x, axis=None) -> Expr:
 
 
 def percentile(x, q, axis=None) -> Expr:
-    """Percentile (linear interpolation); the 1-D multi-device case
-    rides the distributed sample sort like :func:`median`."""
+    """Percentile (linear interpolation), scalar or 1-D vector ``q``;
+    the 1-D multi-device case rides the distributed sample sort like
+    :func:`median` — ONE sort feeds every quantile (vector ``q``
+    gathers the needed order statistics from the sorted result)."""
     x = as_expr(x)
-    try:
-        qf = float(q)
-    except (TypeError, ValueError):
+    scalar_q = np.ndim(q) == 0
+    qa = np.atleast_1d(np.asarray(q, dtype=np.float64))
+    if qa.ndim != 1:
         raise NotImplementedError(
-            "spartan_tpu.percentile supports scalar q only; got "
-            f"q={q!r}. Call percentile once per quantile (the sorted "
-            "intermediate is compile-cached across calls).")
-    if not 0.0 <= qf <= 100.0:
+            "spartan_tpu.percentile supports scalar or 1-D q only; "
+            f"got q with shape {qa.shape}")
+    if qa.size == 0 or np.any(qa < 0.0) or np.any(qa > 100.0) or \
+            np.any(np.isnan(qa)):
         raise ValueError(f"percentile q={q} outside [0, 100]")
     if x.ndim == 1 and axis in (None, 0, -1) and \
             _distributed_sortable(x, 0):
         n = x.shape[0]
         rdt = jnp.result_type(x.dtype, jnp.float32)
-        pos = qf / 100.0 * (n - 1)
-        lo = int(np.floor(pos))
+        pos = qa / 100.0 * (n - 1)
+        lo = np.floor(pos).astype(np.int64)
         # NB: this module shadows builtin min() with the reduce op
-        hi = lo + 1 if lo + 1 <= n - 1 else n - 1
+        hi = np.minimum(lo + 1, n - 1)
         frac = pos - lo
         s = SampleSortExpr(x)
-        out = (1.0 - frac) * astype(s[lo], rdt) \
-            + frac * astype(s[hi], rdt)
+        if scalar_q:
+            out = (1.0 - float(frac[0])) * astype(s[int(lo[0])], rdt) \
+                + float(frac[0]) * astype(s[int(hi[0])], rdt)
+        else:
+            w = as_expr(frac.astype(np.float64))
+            out = (1.0 - w) * astype(take(s, lo), rdt) \
+                + w * astype(take(s, hi), rdt)
+            out = astype(out, rdt)
         return out + _nan_poison(x, rdt)
-    return map_expr(lambda v: jnp.percentile(v, qf, axis=axis), x)
+    # hashable closure capture: the compile cache keys kernels by
+    # captured values, and tuples (unlike ndarrays) compare by content
+    qq = float(qa[0]) if scalar_q else tuple(qa.tolist())
+    return map_expr(
+        lambda v: jnp.percentile(v, jnp.asarray(qq), axis=axis), x)
 
 
 def unique_counts(x, size: int) -> Expr:

@@ -1,4 +1,4 @@
-"""Distributed 1-D sample sort.
+"""Distributed sample sort (1-D and batched axis sort, any length).
 
 Parity with the reference's sampling-based distributed sort
 (``[U] spartan/expr/sort.py``, SURVEY.md §2.3 misc ops). The reference
@@ -7,28 +7,35 @@ their splitter range, and locally sorted. TPU-native redesign: the
 whole algorithm is ONE traced ``shard_map`` program with static shapes
 (XLA-friendly — no data-dependent sizes anywhere):
 
-1. local ``jnp.sort`` per shard (bitonic on TPU);
-2. ``s`` evenly-spaced samples per shard, ``all_gather`` + sort ->
-   ``p - 1`` global splitters;
+1. local two-key ``lax.sort`` per shard — ``(is_padding, value)`` so
+   ragged tails (``n % p != 0`` pads to the next multiple, a validity
+   channel rides the whole pipeline) sort behind every real element;
+2. ``s`` evenly-spaced samples from the shard's VALID prefix,
+   ``all_gather`` + sort -> ``p - 1`` global splitters;
 3. bucket exchange: each shard scatters its sorted elements into a
    fixed ``(p, m)`` send buffer (bucket run *j* goes to row *j*,
-   cannot overflow: a shard holds only ``m`` elements) with a parallel
-   validity mask, one ``all_to_all`` for each;
+   cannot overflow: a shard holds only ``m`` slots) with a parallel
+   validity buffer, one ``all_to_all`` for each;
 4. local merge: two-key ``lax.sort`` (validity, value) over the
    received ``p * m`` slots — real elements first, in order — giving
    this device the full contents of its splitter range (capacity-safe
-   under ANY skew: a bucket can never exceed ``p * m = n``);
-5. rebalance to even row shards: bucket sizes are shared with one
-   ``all_gather``; each device cuts the overlap of its bucket's global
-   rank range with every output shard's ``[j*m, (j+1)*m)`` range (a
-   contiguous run of at most ``m`` elements -> fixed-capacity chunks),
-   exchanges them with a second ``all_to_all``, and scatters into its
-   ``m``-element output shard.
+   under ANY skew: a bucket can never exceed ``p * m``);
+5. rebalance to even row shards: VALID bucket sizes are shared with
+   one ``all_gather``; each device cuts the overlap of its bucket's
+   global rank range with every output shard's ``[j*m, (j+1)*m)``
+   range, exchanges the chunks with a second ``all_to_all``, and
+   scatters into its ``m``-element output shard. Globally the valid
+   elements occupy ranks ``[0, n)`` so the caller just slices the
+   padding back off.
+
+Batched axis sort (:func:`sample_sort_axis`): the same kernel
+``jax.vmap``-ed over the unsharded leading axes — an N-d array sharded
+ALONG its sort axis sorts without ever gathering that axis (the traced
+``jnp.sort`` fallback would all-gather it).
 
 Bandwidth: both exchanges move O(n/p) real payload per device inside
-O(n) padded buffers — the static-shape price; the padding compresses
-to nothing on ICI-bound workloads only in the sense that it is
-sequential HBM traffic, so prefer this path when p is moderate.
+O(n) padded buffers — the static-shape price; prefer this path when p
+is moderate.
 """
 
 from __future__ import annotations
@@ -43,25 +50,31 @@ from ..parallel import mesh as mesh_mod
 _SAMPLES = 64  # per-shard splitter samples (capped at shard size)
 
 
-def _kernel(xs: jax.Array, axis, p: int, s: int,
+def _kernel(xs: jax.Array, axis, p: int, s: int, n: int,
             with_indices: bool = False):
-    """One shard's sample sort; with ``with_indices`` the element's
-    GLOBAL source index rides the whole pipeline as a sort payload and
-    the function returns ``(values, indices)`` — the distributed
-    argsort."""
+    """One shard's sample sort over its ``m``-slot row of the padded
+    array; ``n`` is the true (unpadded) global length, so slots with
+    global index >= n form the validity channel. With ``with_indices``
+    the element's global source index rides the pipeline as a sort
+    payload and the function returns ``(values, indices)`` — the
+    distributed argsort (padding sits at the array's end, so a valid
+    element's padded index IS its original index)."""
     m = xs.shape[0]
     dt = xs.dtype
     me = jax.lax.axis_index(axis)
+    gidx = me.astype(jnp.int32) * m + jnp.arange(m, dtype=jnp.int32)
+    inv = (gidx >= n).astype(jnp.int32)  # 1 = padding slot
     if with_indices:
-        order = jnp.argsort(xs).astype(jnp.int32)
-        xs_sorted = xs[order]
-        src_idx = me.astype(jnp.int32) * m + order     # global indices
+        inv_s, xs_sorted, order = jax.lax.sort(
+            (inv, xs, jnp.arange(m, dtype=jnp.int32)), num_keys=2)
+        src_idx = me.astype(jnp.int32) * m + order  # global indices
     else:  # plain sort: cheaper than argsort + gather
-        xs_sorted = jnp.sort(xs)
+        inv_s, xs_sorted = jax.lax.sort((inv, xs), num_keys=2)
         src_idx = None
+    mv = (m - jnp.sum(inv)).astype(jnp.int32)  # my valid count
 
-    # -- splitters ------------------------------------------------------
-    samp_idx = (jnp.arange(s) * m) // s
+    # -- splitters: s evenly-spaced samples over the valid prefix ------
+    samp_idx = jnp.clip((jnp.arange(s) * mv) // s, 0, m - 1)
     samples = xs_sorted[samp_idx]
     alls = jnp.sort(jax.lax.all_gather(samples, axis, tiled=True))
     splitters = alls[jnp.arange(1, p) * s]             # (p-1,)
@@ -77,7 +90,8 @@ def _kernel(xs: jax.Array, axis, p: int, s: int,
     starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
     pos = jnp.arange(m, dtype=jnp.int32) - starts[dst]
     recv = exchange(jnp.zeros((p, m), dt).at[dst, pos].set(xs_sorted))
-    rvalid = exchange(jnp.zeros((p, m), jnp.int32).at[dst, pos].set(1))
+    rvalid = exchange(jnp.zeros((p, m), jnp.int32)
+                      .at[dst, pos].set(1 - inv_s))
     ridx = exchange(jnp.zeros((p, m), jnp.int32)
                     .at[dst, pos].set(src_idx)) if with_indices else None
 
@@ -117,47 +131,93 @@ def _kernel(xs: jax.Array, axis, p: int, s: int,
     return out_vals, out_idx
 
 
-def sample_sort(x: jax.Array, mesh=None) -> jax.Array:
-    """Sort a 1-D array, row-sharded over the mesh row axis.
+def _padded(x: jax.Array, n: int, p: int):
+    """Pad the last axis to the next multiple of ``p`` (slot count per
+    shard ``m``); padded VALUES are irrelevant — the validity channel
+    governs ordering and output placement."""
+    m = -(-n // p)
+    n_pad = m * p
+    if n_pad != n:
+        widths = [(0, 0)] * (x.ndim - 1) + [(0, n_pad - n)]
+        x = jnp.pad(x, widths)
+    return x, m
 
-    Traceable (usable under an outer jit). Requires
-    ``x.shape[0] % p == 0``; callers fall back to a plain traced
-    ``jnp.sort`` otherwise."""
+
+def _uses(mesh_axis, name) -> bool:
+    """Does a Tiling axis entry involve mesh axis ``name``?"""
+    if mesh_axis == name:
+        return True
+    return isinstance(mesh_axis, tuple) and name in mesh_axis
+
+
+def _run(x: jax.Array, mesh, with_indices: bool,
+         in_tiling=None) -> jax.Array:
+    """Shared driver for every sample-sort entry point: pad the last
+    axis, pick the collective mesh axis, shard_map the (possibly
+    vmapped) kernel, unpad. N-d inputs keep their BATCH-axis shardings
+    (minus any use of the collective axis) — a batch-sharded array is
+    never replicated to sort it."""
     from jax import shard_map
 
     mesh = mesh or mesh_mod.get_mesh()
-    axis = tiling_mod.AXIS_ROW
-    p = int(mesh.shape[axis])
-    n = int(x.shape[0])
-    if p <= 1 or n % p != 0:
-        # the divisibility decision was made against the expr-build-time
-        # mesh; under a different evaluation mesh, fall back rather
-        # than raise (same result, traced jnp.sort)
-        return jnp.sort(x)
-    row = tiling_mod.row(1)
-    x = jax.lax.with_sharding_constraint(x, row.sharding(mesh))
-    s = min(_SAMPLES, n // p)
-    mapped = shard_map(lambda v: _kernel(v, axis, p, s), mesh=mesh,
-                       in_specs=(row.spec(),), out_specs=row.spec())
-    return mapped(x)
+    n = int(x.shape[-1])
+    # collective axis: wherever the sort axis already lives (no
+    # reshard), else the mesh row axis
+    name = tiling_mod.AXIS_ROW
+    if in_tiling is not None and isinstance(in_tiling.axes[-1], str) \
+            and int(mesh.shape.get(in_tiling.axes[-1], 1)) > 1:
+        name = in_tiling.axes[-1]
+    p = int(mesh.shape.get(name, 1))
+    if p <= 1 or n == 0:
+        return (jnp.argsort(x, axis=-1).astype(jnp.int32)
+                if with_indices else jnp.sort(x, axis=-1))
+    xp, m = _padded(x, n, p)
+    batch = tuple(
+        (None if in_tiling is None or _uses(a, name) else a)
+        for a in (in_tiling.axes[:-1] if in_tiling is not None
+                  else (None,) * (x.ndim - 1)))
+    t = tiling_mod.Tiling(batch + (name,))
+    xp = jax.lax.with_sharding_constraint(xp, t.sharding(mesh))
+    s = min(_SAMPLES, m)
+
+    def row_fn(r):
+        out = _kernel(r, name, p, s, n, with_indices=with_indices)
+        return out[1] if with_indices else out
+
+    def block_fn(v):  # local block: batch axes (locally) whole
+        if v.ndim == 1:
+            return row_fn(v)
+        rows = v.reshape((-1, m))
+        return jax.vmap(row_fn)(rows).reshape(v.shape[:-1] + (m,))
+
+    mapped = shard_map(block_fn, mesh=mesh,
+                       in_specs=(t.spec(),), out_specs=t.spec())
+    out = mapped(xp)
+    return out[..., :n] if m * p != n else out
+
+
+def sample_sort(x: jax.Array, mesh=None) -> jax.Array:
+    """Sort a 1-D array of ANY length, sharded over the mesh row axis
+    (ragged tails ride the validity channel). Traceable (usable under
+    an outer jit)."""
+    return _run(x, mesh, with_indices=False)
 
 
 def sample_argsort(x: jax.Array, mesh=None) -> jax.Array:
-    """Indices that sort a 1-D row-sharded array (distributed argsort:
-    global source indices ride the sample-sort pipeline as a sort
-    payload). Same divisibility fallback as :func:`sample_sort`."""
-    from jax import shard_map
+    """Indices that sort a 1-D sharded array of any length
+    (distributed argsort: global source indices ride the sample-sort
+    pipeline as a sort payload)."""
+    return _run(x, mesh, with_indices=True)
 
-    mesh = mesh or mesh_mod.get_mesh()
-    axis = tiling_mod.AXIS_ROW
-    p = int(mesh.shape[axis])
-    n = int(x.shape[0])
-    if p <= 1 or n % p != 0:
-        return jnp.argsort(x).astype(jnp.int32)
-    row = tiling_mod.row(1)
-    x = jax.lax.with_sharding_constraint(x, row.sharding(mesh))
-    s = min(_SAMPLES, n // p)
-    mapped = shard_map(
-        lambda v: _kernel(v, axis, p, s, with_indices=True)[1],
-        mesh=mesh, in_specs=(row.spec(),), out_specs=row.spec())
-    return mapped(x)
+
+def sample_sort_axis(x: jax.Array, mesh=None, with_indices: bool =
+                     False, in_tiling=None) -> jax.Array:
+    """Sort an N-d array along its LAST axis — the 1-D kernel
+    ``vmap``-ed over the (locally whole) leading axes, so the sort
+    axis is never gathered and batch shardings survive. Callers
+    moveaxis before/after for other axes; ``in_tiling`` names the
+    operand's current layout so the collective axis follows the sort
+    axis's existing placement. Indices are within-row positions
+    (``jnp.argsort`` semantics)."""
+    return _run(x, mesh, with_indices=with_indices,
+                in_tiling=in_tiling)

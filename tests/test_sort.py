@@ -63,21 +63,126 @@ def test_sample_sort_2d_mesh(mesh2d):
     np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
 
 
-def test_sort_non_divisible_falls_back(mesh1d):
-    """n % p != 0: the traced jnp.sort path, still oracle-exact."""
+def test_sort_non_divisible_distributed(mesh1d):
+    """n % p != 0 stays on the distributed path (round-4 verdict #3):
+    ragged tails ride the validity channel instead of gathering."""
     rng = np.random.RandomState(5)
-    a = rng.rand(1001).astype(np.float32)
-    e = st.sort(st.from_numpy(a))
-    assert not isinstance(e, SampleSortExpr)
-    np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+    for n in (1001, 8191, 8193):
+        a = rng.rand(n).astype(np.float32)
+        e = st.sort(st.from_numpy(a))
+        assert isinstance(e, SampleSortExpr)
+        np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
 
 
-def test_sort_2d_axis_unchanged(mesh1d):
-    """ndim > 1 keeps the traced per-axis sort."""
+def test_sample_sort_1m_ragged(mesh1d):
+    """Oracle at 1M +/- 7 elements — the verdict's named done-bar."""
+    rng = np.random.RandomState(55)
+    for n in (1_048_576 - 7, 1_048_576 + 7):
+        a = rng.rand(n).astype(np.float32)
+        e = st.sort(st.from_numpy(a))
+        assert isinstance(e, SampleSortExpr)
+        np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sample_sort_tiny_ragged(mesh1d):
+    """n < p and n barely above p: fully-padded shards must not
+    corrupt splitters or counts."""
+    rng = np.random.RandomState(56)
+    for n in (1, 3, 7, 9, 17):
+        a = rng.rand(n).astype(np.float32)
+        e = st.sort(st.from_numpy(a))
+        np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a))
+
+
+def test_sort_2d_local_axis_unchanged(mesh1d):
+    """ndim > 1 with the sort axis UNSHARDED keeps the traced per-axis
+    sort (local under GSPMD — nothing to distribute)."""
     rng = np.random.RandomState(6)
     a = rng.rand(16, 8).astype(np.float32)
     e = st.sort(st.from_numpy(a, tiling=tiling.row(2)), axis=1)
+    assert not isinstance(e, SampleSortExpr)
     np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a, axis=1))
+
+
+def test_sort_axis_sharded_no_gather(mesh1d):
+    """(64, n) sorted along a SHARDED axis 1: distributed batched
+    kernel, oracle-exact, and the compiled HLO moves no full-array
+    all-gather (collective census — round-4 verdict #3 done-bar)."""
+    import re
+
+    from spartan_tpu.utils import profiling
+
+    rng = np.random.RandomState(60)
+    n = 65_536
+    a = rng.rand(64, n).astype(np.float32)
+    t = tiling.Tiling((None, tiling.AXIS_ROW))
+    e = st.sort(st.from_numpy(a, tiling=t), axis=1)
+    assert isinstance(e, SampleSortExpr)
+    hlo = profiling.hlo_text(st.sort(st.from_numpy(a, tiling=t), axis=1))
+    # census: all-gathers may move splitter samples / bucket counts,
+    # never anything within 4x of the full 64 x n array
+    full = a.size * 4  # bytes
+    for m in re.finditer(r"(\S+)\s*=\s*\S*\s*all-gather", hlo):
+        shape = re.search(r"f32\[([\d,]+)\]", m.group(0))
+        if shape:
+            elems = int(np.prod([int(d) for d in
+                                 shape.group(1).split(",")]))
+            assert elems * 4 < full / 4, \
+                f"full-size all-gather in HLO: {m.group(0)}"
+    np.testing.assert_array_equal(np.asarray(e.glom()),
+                                  np.sort(a, axis=1))
+
+
+def test_sort_axis0_sharded(mesh1d):
+    """Sort along a sharded axis 0 (moveaxis wrapping of the batched
+    kernel), ragged rows included."""
+    rng = np.random.RandomState(61)
+    a = rng.rand(8200, 6).astype(np.float32)  # 8200 % 8 != 0
+    e = st.sort(st.from_numpy(a, tiling=tiling.row(2)), axis=0)
+    assert isinstance(e, SampleSortExpr)
+    np.testing.assert_array_equal(np.asarray(e.glom()),
+                                  np.sort(a, axis=0))
+
+
+def test_sort_axis_keeps_batch_sharding(mesh2d):
+    """A batch-sharded operand sorts along its sharded axis WITHOUT
+    replicating the batch axis (round-5 review): the collective runs
+    on the mesh axis already holding the sort axis, batch stays put."""
+    rng = np.random.RandomState(63)
+    a = rng.rand(64, 4096).astype(np.float32)
+    t = tiling.Tiling((tiling.AXIS_ROW, tiling.AXIS_COL))
+    e = st.sort(st.from_numpy(a, tiling=t), axis=1)
+    assert isinstance(e, SampleSortExpr)
+    out = e.evaluate()
+    np.testing.assert_array_equal(np.asarray(out.glom()),
+                                  np.sort(a, axis=1))
+    # no shard holds the whole batch axis
+    shards = out.jax_array.addressable_shards
+    assert all(s.data.shape[0] < 64 for s in shards), \
+        [s.data.shape for s in shards]
+
+
+def test_sort_axis_out_of_range(mesh1d):
+    a = st.from_numpy(np.random.rand(8, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        st.sort(a, axis=2)
+    with pytest.raises(ValueError, match="out of range"):
+        st.argsort(a, axis=-3)
+
+
+def test_argsort_axis_sharded(mesh1d):
+    """Batched distributed argsort along a sharded axis: per-row
+    permutation whose gather reproduces the sorted rows."""
+    rng = np.random.RandomState(62)
+    a = rng.rand(16, 32_768).astype(np.float32)
+    t = tiling.Tiling((None, tiling.AXIS_ROW))
+    e = st.argsort(st.from_numpy(a, tiling=t), axis=1)
+    assert isinstance(e, SampleSortExpr) and e.indices
+    perm = np.asarray(e.glom())
+    assert perm.dtype == np.int32
+    for r in range(16):
+        assert np.array_equal(np.sort(perm[r]), np.arange(a.shape[1]))
+        np.testing.assert_array_equal(a[r][perm[r]], np.sort(a[r]))
 
 
 def test_sample_sort_inf_values(mesh1d):
@@ -112,12 +217,15 @@ def test_sample_argsort_duplicates(mesh2d):
     np.testing.assert_array_equal(a[perm], np.sort(a))
 
 
-def test_argsort_fallback_non_divisible(mesh1d):
+def test_argsort_non_divisible_distributed(mesh1d):
+    """Ragged argsort stays distributed; indices must cover [0, n) and
+    reproduce the sorted order (padding indices never leak out)."""
     rng = np.random.RandomState(10)
     a = rng.rand(1001).astype(np.float32)
     e = st.argsort(st.from_numpy(a))
-    assert not isinstance(e, SampleSortExpr)
+    assert isinstance(e, SampleSortExpr)
     perm = np.asarray(e.glom())
+    assert np.array_equal(np.sort(perm), np.arange(a.size))
     np.testing.assert_array_equal(a[perm], np.sort(a))
 
 
@@ -182,9 +290,35 @@ def test_distributed_median_inf_not_poisoned(mesh1d):
                                np.median(c), rtol=1e-6)
 
 
-def test_percentile_vector_q_message():
-    """Array-valued q gets an explicit NotImplementedError, not an
-    opaque TypeError (round-4 advisor, low)."""
-    a = st.from_numpy(np.arange(16, dtype=np.float32))
-    with pytest.raises(NotImplementedError, match="scalar q"):
-        st.percentile(a, [25.0, 75.0])
+def test_percentile_vector_q(mesh1d):
+    """Vector q (round-4 verdict #3): one distributed sort feeds every
+    quantile; oracle vs numpy, ragged length included."""
+    rng = np.random.RandomState(13)
+    for n in (8192, 1001):
+        a = rng.rand(n).astype(np.float32)
+        fa = (st.from_numpy(a, tiling=tiling.row(1))
+              if n % 8 == 0 else st.from_numpy(a))
+        q = [0.0, 12.5, 50.0, 87.3, 100.0]
+        got = np.asarray(st.percentile(fa, q).glom())
+        assert got.shape == (len(q),)
+        np.testing.assert_allclose(got, np.percentile(a, q),
+                                   rtol=1e-5, atol=1e-6)
+    # 2-D q rejected with a clear message
+    with pytest.raises(NotImplementedError, match="1-D"):
+        st.percentile(fa, [[25.0], [75.0]])
+    # vector q with NaN data: every slot poisons
+    b = rng.rand(640).astype(np.float32)
+    b[17] = np.nan
+    fb = st.from_numpy(b, tiling=tiling.row(1))
+    assert np.all(np.isnan(np.asarray(
+        st.percentile(fb, [10.0, 90.0]).glom())))
+
+
+def test_median_ragged(mesh1d):
+    """Median of non-divisible lengths stays distributed and exact."""
+    rng = np.random.RandomState(14)
+    for n in (1001, 999):
+        a = rng.rand(n).astype(np.float32)
+        fa = st.from_numpy(a)
+        np.testing.assert_allclose(float(st.median(fa).glom()),
+                                   np.median(a), rtol=1e-6)
