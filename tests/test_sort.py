@@ -5,6 +5,8 @@ sampling, all_to_all bucket exchange, local merge, rebalance — on the
 8-virtual-device mesh, including heavy skew (the case splitter
 sampling exists for)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -183,6 +185,54 @@ def test_argsort_axis_sharded(mesh1d):
     for r in range(16):
         assert np.array_equal(np.sort(perm[r]), np.arange(a.shape[1]))
         np.testing.assert_array_equal(a[r][perm[r]], np.sort(a[r]))
+
+
+def test_ragged_all_to_all_semantics_on_tpu():
+    """The ragged transport's offset/size contract, validated on the
+    real chip (the kernel's TPU-only path — XLA:CPU has no
+    ragged-all-to-all thunk, so the in-process CPU suite can't run
+    it). Subprocess on the box's default platform; skips without a
+    TPU."""
+    import subprocess
+    import sys as _sys
+
+    child = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import jax, numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+dev = jax.devices()[0]
+if dev.platform != "tpu":
+    print("NOT_TPU", dev.platform); sys.exit(0)
+mesh = Mesh(np.array([dev]), ("x",))
+def kern(xs):
+    xs = xs.reshape(-1)
+    out = jnp.zeros((8,), xs.dtype) - 1
+    r = jax.lax.ragged_all_to_all(
+        xs, out, jnp.array([1], jnp.int32), jnp.array([3], jnp.int32),
+        jnp.array([2], jnp.int32), jnp.array([3], jnp.int32),
+        axis_name="x")
+    return r.reshape(1, 8)
+x = jax.device_put(jnp.arange(8, dtype=jnp.float32).reshape(1, 8) + 100,
+                   NamedSharding(mesh, P("x", None)))
+got = np.asarray(shard_map(kern, mesh=mesh, in_specs=(P("x", None),),
+                           out_specs=P("x", None))(x))[0]
+exp = np.array([-1, -1, 101, 102, 103, -1, -1, -1], np.float32)
+np.testing.assert_array_equal(got, exp)
+print("RAGGED_OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["REPO"] = repo
+    r = subprocess.run([_sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-1500:]
+    if "NOT_TPU" in r.stdout:
+        pytest.skip("no TPU on this box: " + r.stdout.strip())
+    assert "RAGGED_OK" in r.stdout
 
 
 def test_sample_sort_inf_values(mesh1d):
