@@ -205,6 +205,11 @@ def main():
         "config5_sparse": config5_sparse(st),
     }
     metrics = guard_metrics(report)
+    if not SMALL:
+        # grade BEFORE any threshold rewrite: an --update-thresholds
+        # run must still report regressions against the committed
+        # floors, not against the floors it is about to write
+        report["guard"] = benchguard.check(metrics, platform)
     if "--update-thresholds" in sys.argv and not SMALL:
         path = benchguard.THRESHOLDS_PATH
         try:
@@ -222,8 +227,6 @@ def main():
         with open(path, "w") as f:
             json.dump(table, f, indent=2)
         report["thresholds_updated"] = path
-    if not SMALL:
-        report["guard"] = benchguard.check(metrics, platform)
     print(json.dumps(report, indent=2))
 
 

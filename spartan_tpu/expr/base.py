@@ -460,6 +460,13 @@ def as_expr(value: Any) -> Expr:
         return ValExpr(da.from_numpy(np.asarray(value)))
     if isinstance(value, jax.Array):
         return ValExpr(da.from_jax(value))
+    if type(value).__name__ == "MaskedDistArray":
+        raise TypeError(
+            "this operation does not support MaskedDistArray operands "
+            "(the mask would be silently dropped). Use the mask-aware "
+            "ops — elementwise arithmetic / map_expr, dot, sort, "
+            "argsort, median, concatenate, and the masked reductions — "
+            "or pass .filled(fill) / .data explicitly.")
     raise TypeError(f"cannot lift {type(value).__name__} into an Expr")
 
 

@@ -389,7 +389,12 @@ def sort(x, axis: int = -1) -> Expr:
     in collective form; any length (ragged tails ride a validity
     channel) and any rank (the kernel vmaps over non-sort axes).
     Everything else is a single traced ``jnp.sort`` over the sharded
-    operand (XLA bitonic sort; right when the sort axis is local)."""
+    operand (XLA bitonic sort; right when the sort axis is local).
+    Masked operands sort valid-first, masked-last (numpy.ma)."""
+    from ..array.masked import MaskedDistArray, masked_sort
+
+    if isinstance(x, MaskedDistArray):
+        return masked_sort(x, axis=axis)
     x = as_expr(x)
     ax = _checked_axis(axis, x.ndim)
     if _distributed_sortable(x, ax):
@@ -399,7 +404,12 @@ def sort(x, axis: int = -1) -> Expr:
 
 def argsort(x, axis: int = -1) -> Expr:
     """Indices that sort ``x``; arrays sharded along the sort axis run
-    the distributed sample argsort (see :func:`sort`)."""
+    the distributed sample argsort (see :func:`sort`). Masked operands
+    order valid elements first (numpy.ma semantics)."""
+    from ..array.masked import MaskedDistArray, masked_argsort
+
+    if isinstance(x, MaskedDistArray):
+        return masked_argsort(x, axis=axis)
     x = as_expr(x)
     ax = _checked_axis(axis, x.ndim)
     if _distributed_sortable(x, ax):
@@ -428,7 +438,12 @@ def median(x, axis=None) -> Expr:
     """Median; 1-D multi-device arrays route through the distributed
     sample sort (two order statistics of the sorted result) instead of
     gathering the axis. Matches the traced path's dtype promotion and
-    NaN propagation."""
+    NaN propagation. Masked operands take the median of the UNMASKED
+    elements (numpy.ma; fully-masked slices come out NaN)."""
+    from ..array.masked import MaskedDistArray, masked_median
+
+    if isinstance(x, MaskedDistArray):
+        return masked_median(x, axis=axis)
     x = as_expr(x)
     if x.ndim == 1 and axis in (None, 0, -1) and \
             _distributed_sortable(x, 0):

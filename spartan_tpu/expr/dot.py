@@ -102,7 +102,13 @@ class DotExpr(Expr):
         return tiling_mod.replicated(0)
 
 
-def dot(a: Any, b: Any, precision: Optional[str] = None) -> DotExpr:
+def dot(a: Any, b: Any, precision: Optional[str] = None):
+    """``a @ b``; masked operands route through the mask-aware GEMM
+    (numpy.ma.dot semantics — see array/masked.py masked_dot)."""
+    from ..array.masked import MaskedDistArray, masked_dot
+
+    if isinstance(a, MaskedDistArray) or isinstance(b, MaskedDistArray):
+        return masked_dot(a, b, precision=precision)
     return DotExpr(as_expr(a), as_expr(b), precision)
 
 

@@ -131,7 +131,14 @@ class ConcatExpr(Expr):
         return t.with_axis(self.axis, None)
 
 
-def concatenate(arrays: Sequence[Any], axis: int = 0) -> ConcatExpr:
+def concatenate(arrays: Sequence[Any], axis: int = 0):
+    """Join arrays along an axis; masked operands keep their masks
+    (numpy.ma.concatenate — see array/masked.py)."""
+    from ..array.masked import MaskedDistArray, masked_concatenate
+
+    arrays = list(arrays)
+    if any(isinstance(a, MaskedDistArray) for a in arrays):
+        return masked_concatenate(arrays, axis)
     inputs = [as_expr(a) for a in arrays]
     if not inputs:
         raise ValueError("need at least one array")

@@ -92,6 +92,13 @@ def save(path: str, array: Union[DistArray, "np.ndarray"],
         }
         with open(os.path.join(path, _MANIFEST), "w") as f:
             json.dump(manifest, f)
+    if jax.process_count() > 1:
+        # no rank may report the save complete before the commit
+        # marker exists — a premature teardown on rank 1's return
+        # would otherwise race rank 0's manifest write
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("spartan_tpu_ckpt_commit")
 
 
 def _load_host(path: str, nthreads: int = 8):

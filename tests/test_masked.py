@@ -257,3 +257,143 @@ def test_average_rejects_bad_weights(pair):
                          np.zeros(6, bool))
     with pytest.raises(ValueError, match="not compatible"):
         d1.average(weights=np.ones(4, np.float32))
+
+
+# -- mask-aware general ops (round-4 verdict Missing #3) ----------------
+
+
+def _ma_pair(shape, frac=0.3, seed=31):
+    rng = np.random.RandomState(seed)
+    data = rng.rand(*shape).astype(np.float32)
+    mask = rng.rand(*shape) < frac
+    return (np.ma.masked_array(data, mask),
+            MaskedDistArray(data, mask))
+
+
+def test_masked_dot_oracle(mesh2d):
+    nma, sma = _ma_pair((24, 16), seed=41)
+    nmb, smb = _ma_pair((16, 20), seed=42)
+    got = st.dot(sma, smb).glom()
+    ref = np.ma.dot(nma, nmb)
+    np.testing.assert_allclose(np.ma.filled(got.astype(np.float64), 0),
+                               np.ma.filled(ref.astype(np.float64), 0),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.ma.getmaskarray(got),
+                                  np.ma.getmaskarray(ref))
+    # mixed masked x plain
+    b = np.asarray(nmb.data)
+    got2 = st.dot(sma, b).glom()
+    ref2 = np.ma.dot(nma, b)
+    np.testing.assert_allclose(np.ma.filled(got2.astype(np.float64), 0),
+                               np.ma.filled(ref2.astype(np.float64), 0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_masked_dot_fully_masked_cell(mesh2d):
+    """A result cell with NO valid (a, b) pair is masked, like
+    np.ma.dot."""
+    a = np.ma.masked_array(np.ones((2, 3), np.float32),
+                           [[True, True, True], [False, False, False]])
+    b = np.ones((3, 2), np.float32)
+    sa = MaskedDistArray(np.asarray(a.data), np.ma.getmaskarray(a))
+    got = st.dot(sa, b).glom()
+    ref = np.ma.dot(a, b)
+    np.testing.assert_array_equal(np.ma.getmaskarray(got),
+                                  np.ma.getmaskarray(ref))
+    assert np.ma.getmaskarray(got)[0].all()
+    np.testing.assert_allclose(np.ma.filled(got, 0),
+                               np.ma.filled(ref, 0), rtol=1e-6)
+
+
+def test_masked_sort_and_argsort(mesh2d):
+    nma, sma = _ma_pair((8, 12), seed=43)
+    for axis in (0, 1, -1):
+        got = st.sort(sma, axis=axis).glom()
+        ref = np.ma.sort(nma, axis=axis)
+        np.testing.assert_array_equal(np.ma.getmaskarray(got),
+                                      np.ma.getmaskarray(ref))
+        np.testing.assert_allclose(
+            np.ma.filled(got.astype(np.float64), -1),
+            np.ma.filled(ref.astype(np.float64), -1), rtol=1e-6)
+    perm = np.asarray(st.argsort(sma, axis=1).glom())
+    # valid elements ordered first, ascending
+    dat = np.asarray(nma.data)
+    msk = np.ma.getmaskarray(nma)
+    for r in range(8):
+        k = int((~msk[r]).sum())
+        vals = dat[r][perm[r][:k]]
+        assert not msk[r][perm[r][:k]].any()
+        assert np.all(np.diff(vals) >= 0)
+
+
+def test_masked_median_oracle(mesh1d):
+    nma, sma = _ma_pair((64,), seed=44)
+    got = float(st.median(sma).glom())
+    np.testing.assert_allclose(got, np.ma.median(nma), rtol=1e-6)
+    nmb, smb = _ma_pair((6, 10), seed=45)
+    got2 = np.asarray(st.median(smb, axis=1).glom())
+    ref2 = np.ma.filled(np.ma.median(nmb, axis=1).astype(np.float64),
+                        np.nan)
+    np.testing.assert_allclose(got2, ref2, rtol=1e-5, equal_nan=True)
+    # fully-masked row: NaN (the Expr-level masked result)
+    full = MaskedDistArray(np.ones((2, 4), np.float32),
+                           np.array([[True] * 4, [False] * 4]))
+    out = np.asarray(st.median(full, axis=1).glom())
+    assert np.isnan(out[0]) and out[1] == 1.0
+    # a genuine NaN in a VALID slot poisons (numpy.ma does not treat
+    # NaN as missing) — but a NaN in a MASKED slot does not
+    d = np.array([[1.0, np.nan, 3.0], [1.0, np.nan, 3.0]], np.float32)
+    mk = np.array([[False, False, True], [False, True, False]])
+    mm = MaskedDistArray(d, mk)
+    out2 = np.asarray(st.median(mm, axis=1).glom())
+    assert np.isnan(out2[0])        # valid NaN -> NaN
+    assert out2[1] == 2.0           # masked NaN skipped: median(1, 3)
+
+
+def test_masked_sort_axis_out_of_range(mesh1d):
+    _, sma = _ma_pair((4, 4), seed=51)
+    with pytest.raises(ValueError, match="out of range"):
+        st.sort(sma, axis=2)
+    with pytest.raises(ValueError, match="out of range"):
+        st.argsort(sma, axis=-3)
+
+
+def test_masked_concatenate(mesh1d):
+    nma, sma = _ma_pair((5, 4), seed=46)
+    nmb, smb = _ma_pair((3, 4), seed=47)
+    got = st.concatenate([sma, smb], axis=0).glom()
+    ref = np.ma.concatenate([nma, nmb], axis=0)
+    np.testing.assert_array_equal(np.ma.getmaskarray(got),
+                                  np.ma.getmaskarray(ref))
+    np.testing.assert_allclose(np.ma.filled(got, 9), np.ma.filled(ref, 9),
+                               rtol=1e-6)
+    # plain operand contributes an all-False mask
+    plain = np.ones((2, 4), np.float32)
+    got2 = st.concatenate([sma, plain], axis=0).glom()
+    assert not np.ma.getmaskarray(got2)[5:].any()
+
+
+def test_masked_map_expr_propagates(mesh1d):
+    from spartan_tpu.expr.map import map as map_expr
+
+    nma, sma = _ma_pair((16,), seed=48)
+    nmb, smb = _ma_pair((16,), seed=49)
+    got = map_expr(lambda a, b: a * 2.0 + b, sma, smb)
+    assert isinstance(got, MaskedDistArray)
+    ref_mask = np.ma.getmaskarray(nma) | np.ma.getmaskarray(nmb)
+    g = got.glom()
+    np.testing.assert_array_equal(np.ma.getmaskarray(g), ref_mask)
+    np.testing.assert_allclose(
+        np.asarray(g.data)[~ref_mask],
+        (np.asarray(nma.data) * 2.0 + np.asarray(nmb.data))[~ref_mask],
+        rtol=1e-6)
+
+
+def test_masked_unsupported_op_raises(mesh1d):
+    """An op without a mask-aware path refuses the masked operand with
+    a clear message instead of silently dropping the mask."""
+    _, sma = _ma_pair((16,), seed=50)
+    with pytest.raises(TypeError, match="MaskedDistArray"):
+        st.cumsum(sma)
+    with pytest.raises(TypeError, match="mask-aware"):
+        st.einsum("i,i->", sma, sma)
