@@ -407,6 +407,16 @@ def main() -> None:
             cpu_km = _baseline("kmeans_1m", "iters_per_sec_1m")
             if cpu_km:
                 result["kmeans_vs_baseline"] = round(km["value"] / cpu_km, 1)
+                # the denominator's provenance rides the artifact,
+                # derived from the baseline file so it cannot go stale
+                # if the baseline is re-measured (round-4 Weak #3)
+                n_meas = _baseline("kmeans_1m", "n_measured")
+                n_tgt = _baseline("kmeans_1m", "target_n")
+                if n_meas and n_tgt and n_meas != n_tgt:
+                    result["kmeans_baseline_note"] = (
+                        f"CPU denominator extrapolated linearly from a "
+                        f"{n_meas:,}-row measurement to {n_tgt:,} rows "
+                        f"(baselines/cpu_baseline.json; docs/BENCH.md)")
             print(f"[bench] kmeans stage: {km['value']} iters/s",
                   file=sys.stderr)
         else:
