@@ -118,6 +118,86 @@ def test_shuffle_identity_roundtrip_fuzz(mesh2d):
         np.testing.assert_array_equal(np.asarray(out.glom()), a)
 
 
+def test_contract_fuzz_vs_einsum_oracle(mesh2d):
+    """Random 2-operand contraction specs (batch/free/contraction/
+    summed label mixes, random dims) through the PLANNED ContractExpr
+    path match np.einsum exactly — the round-5 planner surface under
+    random geometry."""
+    import string
+
+    rng = np.random.RandomState(6)
+    for trial in range(25):
+        n_lab = rng.randint(2, 6)
+        labs = list(string.ascii_lowercase[:n_lab])
+        dims = {c: int(rng.randint(1, 6)) for c in labs}
+        nda = rng.randint(1, min(4, n_lab) + 1)
+        ndb = rng.randint(1, min(4, n_lab) + 1)
+        la = list(rng.choice(labs, nda, replace=False))
+        lb = list(rng.choice(labs, ndb, replace=False))
+        # output: random subset of the operand labels, no repeats
+        pool = sorted(set(la) | set(lb))
+        n_out = rng.randint(0, len(pool) + 1)
+        lo = list(rng.choice(pool, n_out, replace=False))
+        spec = "".join(la) + "," + "".join(lb) + "->" + "".join(lo)
+        a = rng.rand(*(dims[c] for c in la)).astype(np.float32)
+        b = rng.rand(*(dims[c] for c in lb)).astype(np.float32)
+        got = st.einsum(spec, st.from_numpy(a),
+                        st.from_numpy(b)).optimized()
+        np.testing.assert_allclose(np.asarray(got.glom()),
+                                   np.einsum(spec, a, b),
+                                   rtol=2e-4, atol=1e-5,
+                                   err_msg=spec)
+
+
+def test_ragged_sort_fuzz(mesh1d):
+    """Random lengths (prime, tiny, around multiples of p) and dtypes
+    through the distributed sort: oracle-exact, and argsort always a
+    valid permutation."""
+    rng = np.random.RandomState(7)
+    for trial in range(12):
+        n = int(rng.choice([1, 2, 7, 8, 9, 63, 64, 65, 997, 1024,
+                            2049, 4093]))
+        if rng.rand() < 0.5:
+            a = rng.randint(-50, 50, n).astype(np.int32)
+        else:
+            a = (rng.randn(n) * rng.choice([1e-3, 1.0, 1e6])
+                 ).astype(np.float32)
+        e = st.sort(st.from_numpy(a))
+        np.testing.assert_array_equal(np.asarray(e.glom()), np.sort(a),
+                                      err_msg=f"n={n} dtype={a.dtype}")
+        perm = np.asarray(st.argsort(st.from_numpy(a)).glom())
+        assert np.array_equal(np.sort(perm), np.arange(n)), f"n={n}"
+        np.testing.assert_array_equal(a[perm], np.sort(a))
+
+
+def test_masked_ops_fuzz(mesh2d):
+    """Random masks/shapes through the mask-aware ops vs numpy.ma."""
+    rng = np.random.RandomState(8)
+    from spartan_tpu.array.masked import MaskedDistArray
+
+    for trial in range(8):
+        m, k, n = (int(rng.randint(2, 10)) for _ in range(3))
+        da = rng.rand(m, k).astype(np.float32)
+        db = rng.rand(k, n).astype(np.float32)
+        ma = rng.rand(m, k) < rng.choice([0.0, 0.3, 0.8])
+        mb = rng.rand(k, n) < rng.choice([0.0, 0.3, 0.8])
+        got = st.dot(MaskedDistArray(da, ma),
+                     MaskedDistArray(db, mb)).glom()
+        ref = np.ma.dot(np.ma.masked_array(da, ma),
+                        np.ma.masked_array(db, mb))
+        np.testing.assert_array_equal(np.ma.getmaskarray(got),
+                                      np.ma.getmaskarray(ref))
+        np.testing.assert_allclose(np.ma.filled(got, 0.0),
+                                   np.ma.filled(ref, 0.0),
+                                   rtol=1e-4, atol=1e-5)
+        srt = st.sort(MaskedDistArray(da, ma), axis=1).glom()
+        ref_s = np.ma.sort(np.ma.masked_array(da, ma), axis=1)
+        np.testing.assert_array_equal(np.ma.getmaskarray(srt),
+                                      np.ma.getmaskarray(ref_s))
+        np.testing.assert_allclose(np.ma.filled(srt, -1.0),
+                                   np.ma.filled(ref_s, -1.0), rtol=1e-6)
+
+
 def test_shuffle_random_emissions_vs_numpy_add(mesh1d):
     """Kernels emitting RANDOM (possibly overlapping) extents with the
     add combiner match a numpy scatter-add oracle."""
