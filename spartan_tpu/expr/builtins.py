@@ -417,11 +417,12 @@ def argsort(x, axis: int = -1) -> Expr:
     return map_expr(lambda v: jnp.argsort(v, axis=ax), x)
 
 
-def _nan_poison(x: Expr, rdt) -> Any:
-    """0 when ``x`` is NaN-free, NaN otherwise — added to distributed
-    order statistics so median/percentile propagate NaN exactly like
-    the traced jnp fallbacks (the sample sort orders NaN to one end,
-    which would otherwise silently hide it).
+def _nan_poison(x: Expr, rdt, axis=None) -> Any:
+    """0 when ``x`` is NaN-free, NaN otherwise (per slice of ``axis``
+    when given) — added to distributed order statistics so
+    median/percentile propagate NaN exactly like the traced jnp
+    fallbacks (the sample sort orders NaN to one end, which would
+    otherwise silently hide it).
 
     Derived from NaN-ness alone: counting ``isnan`` per element keeps
     inf inputs and f32 sum overflow (both of which poisoned the old
@@ -429,30 +430,67 @@ def _nan_poison(x: Expr, rdt) -> Any:
     if not np.issubdtype(np.dtype(rdt), np.floating) or \
             not np.issubdtype(np.dtype(x.dtype), np.floating):
         return 0.0  # int inputs can't hold NaN: skip the scan entirely
-    cnt = sum(map_expr(lambda v: jnp.isnan(v).astype(jnp.float32), x))
+    cnt = sum(map_expr(lambda v: jnp.isnan(v).astype(jnp.float32), x),
+              axis=axis)
     return map_expr(
         lambda c: jnp.where(c > 0, jnp.nan, 0.0).astype(rdt), cnt)
 
 
+def _axis_order_stat_path(x: Expr, axis) -> Any:
+    """The normalized axis when an order statistic (median /
+    percentile along ``axis``) should ride the distributed sort — the
+    operand is sharded along that axis, so the traced fallback would
+    all-gather it. None otherwise. 1-D arrays sort on axis 0 for
+    ``axis`` in (None, 0, -1); N-d arrays need an integer axis."""
+    if x.ndim == 0 or x.size == 0:
+        return None
+    if x.ndim == 1:
+        if axis not in (None, 0, -1):
+            return None
+        return 0 if _distributed_sortable(x, 0) else None
+    if axis is None or not isinstance(axis, (int, np.integer)):
+        return None
+    ax = _checked_axis(int(axis), x.ndim)
+    return ax if _distributed_sortable(x, ax) else None
+
+
+def _order_stat_interp(x: Expr, ax: int, positions, rdt):
+    """Linearly-interpolated order statistics of ``x`` along ``ax``
+    at fractional ``positions``, read off ONE distributed sort
+    (SampleSortExpr); each result drops ``ax``. The shared kernel of
+    median and scalar-q percentile, 1-D and N-d alike. Operands are
+    promoted to ``rdt`` BEFORE combining: int middles could overflow."""
+    n = x.shape[ax]
+    s = SampleSortExpr(x, axis=ax)
+    pre = (slice(None),) * ax
+    outs = []
+    for pos in positions:
+        lo = int(np.floor(pos))
+        hi = lo + 1 if lo + 1 <= n - 1 else n - 1
+        fr = float(pos - lo)
+        outs.append((1.0 - fr) * astype(s[pre + (lo,)], rdt)
+                    + fr * astype(s[pre + (hi,)], rdt))
+    return outs
+
+
 def median(x, axis=None) -> Expr:
-    """Median; 1-D multi-device arrays route through the distributed
-    sample sort (two order statistics of the sorted result) instead of
-    gathering the axis. Matches the traced path's dtype promotion and
-    NaN propagation. Masked operands take the median of the UNMASKED
+    """Median; arrays sharded along the reduction axis (1-D arrays,
+    and any N-d axis) route through the distributed sample sort (two
+    order statistics of the sorted result) instead of gathering the
+    axis. Matches the traced path's dtype promotion and NaN
+    propagation. Masked operands take the median of the UNMASKED
     elements (numpy.ma; fully-masked slices come out NaN)."""
     from ..array.masked import MaskedDistArray, masked_median
 
     if isinstance(x, MaskedDistArray):
         return masked_median(x, axis=axis)
     x = as_expr(x)
-    if x.ndim == 1 and axis in (None, 0, -1) and \
-            _distributed_sortable(x, 0):
-        n = x.shape[0]
+    ax = _axis_order_stat_path(x, axis)
+    if ax is not None:
         rdt = jnp.result_type(x.dtype, jnp.float32)
-        s = SampleSortExpr(x)
-        # promote BEFORE summing: int middles could overflow
-        mid = astype(s[(n - 1) // 2], rdt) + astype(s[n // 2], rdt)
-        return 0.5 * mid + _nan_poison(x, rdt)
+        n = x.shape[ax]
+        (out,) = _order_stat_interp(x, ax, [(n - 1) / 2.0], rdt)
+        return out + _nan_poison(x, rdt, axis=ax)
     return map_expr(lambda v: jnp.median(v, axis=axis), x)
 
 
@@ -471,8 +509,16 @@ def percentile(x, q, axis=None) -> Expr:
     if qa.size == 0 or np.any(qa < 0.0) or np.any(qa > 100.0) or \
             np.any(np.isnan(qa)):
         raise ValueError(f"percentile q={q} outside [0, 100]")
-    if x.ndim == 1 and axis in (None, 0, -1) and \
-            _distributed_sortable(x, 0):
+    ax = _axis_order_stat_path(x, axis)
+    if ax is not None and scalar_q:
+        rdt = jnp.result_type(x.dtype, jnp.float32)
+        n = x.shape[ax]
+        (out,) = _order_stat_interp(
+            x, ax, [float(qa[0]) / 100.0 * (n - 1)], rdt)
+        return out + _nan_poison(x, rdt, axis=ax)
+    if ax is not None and x.ndim == 1:
+        # vector q: gather every quantile's order statistics from ONE
+        # distributed sort
         n = x.shape[0]
         rdt = jnp.result_type(x.dtype, jnp.float32)
         pos = qa / 100.0 * (n - 1)
@@ -481,15 +527,10 @@ def percentile(x, q, axis=None) -> Expr:
         hi = np.minimum(lo + 1, n - 1)
         frac = pos - lo
         s = SampleSortExpr(x)
-        if scalar_q:
-            out = (1.0 - float(frac[0])) * astype(s[int(lo[0])], rdt) \
-                + float(frac[0]) * astype(s[int(hi[0])], rdt)
-        else:
-            w = as_expr(frac.astype(np.float64))
-            out = (1.0 - w) * astype(take(s, lo), rdt) \
-                + w * astype(take(s, hi), rdt)
-            out = astype(out, rdt)
-        return out + _nan_poison(x, rdt)
+        w = as_expr(frac.astype(np.float64))
+        out = (1.0 - w) * astype(take(s, lo), rdt) \
+            + w * astype(take(s, hi), rdt)
+        return astype(out, rdt) + _nan_poison(x, rdt)
     # hashable closure capture: the compile cache keys kernels by
     # captured values, and tuples (unlike ndarrays) compare by content
     qq = float(qa[0]) if scalar_q else tuple(qa.tolist())
