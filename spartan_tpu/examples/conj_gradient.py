@@ -8,8 +8,6 @@ the driver loop hits the structural cache.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 import spartan_tpu as st

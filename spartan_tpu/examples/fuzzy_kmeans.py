@@ -5,8 +5,6 @@ traced computation: membership weights + weighted center accumulation
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax.numpy as jnp
 import numpy as np
 

@@ -10,8 +10,6 @@ traced computation over batch-sharded X, y: local matmul + psum gradient
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np

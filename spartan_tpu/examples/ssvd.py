@@ -11,12 +11,11 @@ from __future__ import annotations
 
 from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 import spartan_tpu as st
-from ..expr.base import Expr, as_expr
+from ..expr.base import as_expr
 from ..expr.map2 import map2
 from ..array import tiling as tiling_mod
 

@@ -7,7 +7,6 @@ computation with a psum'd gradient (the DP pattern of SURVEY.md §2.6).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -18,9 +18,6 @@ All variants accumulate in f32 and match the dense oracle.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

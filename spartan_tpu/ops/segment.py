@@ -17,7 +17,6 @@ for scatter-add / segment-sum merges"). Three paths:
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

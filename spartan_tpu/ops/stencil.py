@@ -9,7 +9,7 @@ halo transfers when the inputs are sharded.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple, Union
+from typing import Tuple, Union
 
 import jax
 import jax.numpy as jnp

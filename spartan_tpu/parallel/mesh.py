@@ -21,7 +21,7 @@ from __future__ import annotations
 import math
 import os
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np

@@ -16,13 +16,12 @@ into a ``lax.fori_loop`` of compute + collective_permute.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_mod
 
