@@ -29,6 +29,7 @@ __all__ = [
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
     "sort", "argsort", "median", "percentile", "unique_counts",
+    "unique",
     "isnan", "isinf",
     "isfinite", "logical_not", "var", "std", "ptp", "cumsum", "cumprod",
     "take", "linspace", "log1p", "expm1", "log2", "log10", "floor", "ceil",
@@ -541,6 +542,50 @@ def percentile(x, q, axis=None) -> Expr:
 def unique_counts(x, size: int) -> Expr:
     """Counts of each value in [0, size) — static-shape unique()."""
     return bincount(x, length=size)
+
+
+def unique(x, size: int, fill_value=0.0, return_counts: bool = False):
+    """Sorted unique values with STATIC output size (``jnp.unique``'s
+    ``size=`` convention: the output is padded with ``fill_value``
+    past the distinct count, and distinct values beyond ``size`` are
+    dropped — XLA needs static shapes).
+
+    One pipeline serves every mesh size and rank (N-d flattens, like
+    np.unique): sort (the distributed sample sort where the operand is
+    sharded, a local traced sort otherwise) -> boundary flags (a
+    shifted compare GSPMD resolves with a halo exchange) -> prefix
+    scan for dense ranks -> scatter into the static output; counts are
+    the bincount reduction over ranks, sharing the single sort. NaNs
+    compare unequal, so each NaN counts as its own value (the
+    sort-based convention)."""
+    from .map2 import map2
+
+    x = as_expr(x)
+    size = int(size)
+    if size <= 0:
+        raise ValueError(f"unique needs size >= 1, got {size}")
+    if x.ndim != 1:
+        x = ravel(x)
+    if x.size == 0:
+        vals = full((size,), fill_value, x.dtype)
+        if not return_counts:
+            return vals
+        return vals, zeros((size,), np.int32)
+    s = sort(x)
+    flags = map_expr(
+        lambda v: jnp.concatenate(
+            [jnp.ones((1,), jnp.int32),
+             (v[1:] != v[:-1]).astype(jnp.int32)]), s)
+    rank = cumsum(flags) - 1
+    vals = map2(
+        [s, rank, flags],
+        lambda v, r, f, size, fill: jnp.full(
+            (size,), fill, v.dtype)
+        .at[jnp.where(f == 1, r, size)].set(v, mode="drop"),
+        fn_kw={"size": size, "fill": fill_value})
+    if not return_counts:
+        return vals
+    return vals, bincount(rank, length=size)
 
 
 def linspace(start, stop, num=50, endpoint=True, dtype=np.float32,

@@ -417,6 +417,49 @@ def test_median_percentile_nd_sharded_axis(mesh1d):
                                rtol=1e-6)
 
 
+def test_unique_distributed(mesh1d):
+    """Static-size unique composes sort + blocked scan + scatter on
+    the mesh; oracle vs np.unique (values and counts), ragged length
+    and heavy duplication included."""
+    rng = np.random.RandomState(16)
+    for n in (8192, 1001):
+        a = rng.randint(0, 200, n).astype(np.int32)
+        ref_v, ref_c = np.unique(a, return_counts=True)
+        k = ref_v.size
+        vals, cnts = st.unique(st.from_numpy(a), size=k + 8,
+                               fill_value=-1, return_counts=True)
+        gv, gc = np.asarray(vals.glom()), np.asarray(cnts.glom())
+        np.testing.assert_array_equal(gv[:k], ref_v)
+        assert (gv[k:] == -1).all()
+        np.testing.assert_array_equal(gc[:k], ref_c)
+        assert (gc[k:] == 0).all()
+    # floats with duplicates
+    b = rng.choice(np.linspace(0, 1, 37).astype(np.float32), 4096)
+    ref = np.unique(b)
+    got = np.asarray(st.unique(st.from_numpy(b), size=64,
+                               fill_value=np.inf).glom())
+    np.testing.assert_array_equal(got[:ref.size], ref)
+    # size smaller than the distinct count: truncation, no error
+    got2 = np.asarray(st.unique(st.from_numpy(b), size=10).glom())
+    np.testing.assert_array_equal(got2, ref[:10])
+    # single-value edge
+    c = np.full(64, 7.0, np.float32)
+    gv3 = np.asarray(st.unique(st.from_numpy(c), size=4,
+                               fill_value=0).glom())
+    np.testing.assert_array_equal(gv3, [7.0, 0, 0, 0])
+    # N-d input flattens (np.unique semantics); counts share the sort
+    d = rng.randint(0, 9, (16, 8)).astype(np.int32)
+    rv, rc = np.unique(d, return_counts=True)
+    v4, c4 = st.unique(st.from_numpy(d), size=16, fill_value=-1,
+                       return_counts=True)
+    np.testing.assert_array_equal(np.asarray(v4.glom())[:rv.size], rv)
+    np.testing.assert_array_equal(np.asarray(c4.glom())[:rv.size], rc)
+    # tiny input (n < p)
+    e5 = st.unique(st.from_numpy(np.array([3.0, 1.0, 3.0], np.float32)),
+                   size=4, fill_value=9)
+    np.testing.assert_array_equal(np.asarray(e5.glom()), [1, 3, 9, 9])
+
+
 def test_median_ragged(mesh1d):
     """Median of non-divisible lengths stays distributed and exact."""
     rng = np.random.RandomState(14)
