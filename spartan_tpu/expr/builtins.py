@@ -28,8 +28,8 @@ __all__ = [
     "argmin", "reduce", "ndarray", "norm", "diag", "diagonal", "tril",
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
-    "sort", "argsort", "median", "percentile", "unique_counts",
-    "unique",
+    "sort", "argsort", "median", "percentile", "quantile", "histogram",
+    "unique_counts", "unique",
     "isnan", "isinf",
     "isfinite", "logical_not", "var", "std", "ptp", "cumsum", "cumprod",
     "take", "linspace", "log1p", "expm1", "log2", "log10", "floor", "ceil",
@@ -537,6 +537,92 @@ def percentile(x, q, axis=None) -> Expr:
     qq = float(qa[0]) if scalar_q else tuple(qa.tolist())
     return map_expr(
         lambda v: jnp.percentile(v, jnp.asarray(qq), axis=axis), x)
+
+
+def quantile(x, q, axis=None) -> Expr:
+    """``np.quantile``: :func:`percentile` with q in [0, 1]."""
+    qa = np.asarray(q, dtype=np.float64)
+    if qa.size and (np.any(qa < 0.0) or np.any(qa > 1.0)):
+        raise ValueError(f"quantile q={q} outside [0, 1]")
+    return percentile(x, qa * 100.0 if np.ndim(q) else float(qa) * 100.0,
+                      axis=axis)
+
+
+def histogram(x, bins: int = 10, range=None):
+    """``np.histogram`` with STATIC bin count: (counts, edges).
+
+    Distributed as bucketing (a searchsorted map over the sharded
+    operand) + the bincount reduction; ``range`` defaults to the
+    operand's (min, max) — computed in the same program when not
+    given. With an explicit ``range`` the edges are a host constant
+    (np.histogram semantics: values outside it are dropped)."""
+    x = as_expr(x)
+    bins = int(bins)
+    if bins <= 0:
+        raise ValueError(f"histogram needs bins >= 1, got {bins}")
+    if x.size == 0:
+        # np.histogram of an empty array: zero counts over (0, 1)
+        lo, hi = (float(range[0]), float(range[1])) \
+            if range is not None else (0.0, 1.0)
+        return (zeros((bins,), np.int32),
+                as_expr(np.linspace(lo, hi, bins + 1)
+                        .astype(np.float32)))
+    if range is not None:
+        lo, hi = float(range[0]), float(range[1])
+        if not lo < hi:
+            raise ValueError(f"histogram range {range} is empty")
+        # edges are f32 on device (no x64); captured as SCALARS so the
+        # kernel's compile-cache key repeats across calls (fn_key hashes
+        # closure cells — an ndarray capture would key by id and
+        # recompile every call)
+        edges = as_expr(np.linspace(lo, hi, bins + 1)
+                        .astype(np.float32))
+
+        def bucket(v, lo=lo, hi=hi, bins=bins):
+            e = jnp.linspace(jnp.float32(lo), jnp.float32(hi), bins + 1)
+            idx = jnp.searchsorted(e, v.astype(e.dtype),
+                                   side="right") - 1
+            # np.histogram: the last bin is closed on the right
+            idx = jnp.where(v.astype(e.dtype) == e[-1], bins - 1, idx)
+            oob = (v.astype(e.dtype) < e[0]) | (v.astype(e.dtype)
+                                                > e[-1])
+            return jnp.where(oob, bins, idx).astype(jnp.int32)
+
+        counts = bincount(map_expr(bucket, x), length=bins)
+        return counts, edges
+    # data-dependent range: min/max reductions feed the bucketing map
+    # inside one traced program (no host round trip). A degenerate
+    # range (all values equal) expands to value +/- 0.5, np.histogram
+    # style. f32 throughout: f64 is unavailable on-device without x64.
+    from .reduce import max as _rmax
+    from .reduce import min as _rmin
+
+    lo_e, hi_e = _rmin(x), _rmax(x)
+
+    def bucket2(v, lo, hi):
+        # searchsorted on the same edges np.histogram uses (not a
+        # floor-div, whose f32 width rounding buckets exact-edge
+        # values one bin low)
+        lo = lo.astype(jnp.float32)
+        hi = hi.astype(jnp.float32)
+        lo, hi = (jnp.where(hi > lo, lo, lo - 0.5),
+                  jnp.where(hi > lo, hi, hi + 0.5))
+        e = lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
+        idx = jnp.searchsorted(e, v.astype(jnp.float32),
+                               side="right") - 1
+        return jnp.clip(idx, 0, bins - 1).astype(jnp.int32)
+
+    counts = bincount(map_expr(bucket2, x, lo_e, hi_e), length=bins)
+
+    def edges_fn(lo, hi):
+        lo = lo.astype(jnp.float32)
+        hi = hi.astype(jnp.float32)
+        lo, hi = (jnp.where(hi > lo, lo, lo - 0.5),
+                  jnp.where(hi > lo, hi, hi + 0.5))
+        return lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
+
+    edges = map_expr(edges_fn, lo_e, hi_e)
+    return counts, edges
 
 
 def unique_counts(x, size: int) -> Expr:

@@ -161,3 +161,68 @@ def test_einsum_cache_keys_on_subscripts(mesh2d):
     s2 = np.asarray(st.einsum("ij->ij", ea).glom())
     np.testing.assert_array_equal(s1, a.T)
     np.testing.assert_array_equal(s2, a)
+
+
+def test_quantile_matches_percentile(mesh1d):
+    rng = np.random.RandomState(32)
+    a = rng.rand(8192).astype(np.float32)
+    fa = st.from_numpy(a, tiling=tiling.row(1))
+    np.testing.assert_allclose(float(st.quantile(fa, 0.37).glom()),
+                               np.quantile(a, 0.37), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(st.quantile(fa, [0.1, 0.9]).glom()),
+        np.quantile(a, [0.1, 0.9]), rtol=1e-5)
+    with pytest.raises(ValueError, match=r"outside \[0, 1\]"):
+        st.quantile(fa, 37.0)
+
+
+def test_histogram_oracle(mesh1d):
+    """np.histogram parity: explicit range (edges a host constant,
+    out-of-range dropped, right-closed last bin) and data-dependent
+    range (min/max folded into the same program)."""
+    rng = np.random.RandomState(33)
+    a = (rng.rand(100_000) * 10 - 2).astype(np.float32)
+    fa = st.from_numpy(a, tiling=tiling.row(1))
+    # explicit range
+    counts, edges = st.histogram(fa, bins=16, range=(0.0, 8.0))
+    rc, re = np.histogram(a, bins=16, range=(0.0, 8.0))
+    np.testing.assert_array_equal(np.asarray(counts.glom()), rc)
+    np.testing.assert_allclose(np.asarray(edges.glom()), re, rtol=1e-6)
+    # data-dependent range: edges match; counts may differ by boundary
+    # ulps in f32 vs numpy's f64 bucketing — compare totals + near-all
+    counts2, edges2 = st.histogram(fa, bins=12)
+    rc2, re2 = np.histogram(a, bins=12)
+    g2 = np.asarray(counts2.glom())
+    np.testing.assert_allclose(np.asarray(edges2.glom()), re2,
+                               rtol=1e-5)
+    assert g2.sum() == a.size
+    assert np.abs(g2 - rc2).sum() <= 8  # boundary-ulp tolerance
+    # ints, exact
+    b = rng.randint(0, 50, 10_000)
+    cb, eb = st.histogram(st.from_numpy(b.astype(np.int32)), bins=10)
+    rcb, reb = np.histogram(b, bins=10)
+    np.testing.assert_array_equal(np.asarray(cb.glom()), rcb)
+
+
+def test_histogram_edge_cases(mesh1d):
+    """Degenerate range (constant data) expands value +/- 0.5 like
+    np.histogram; empty input returns zero counts over (0, 1); the
+    explicit-range kernel's compile cache repeats across calls."""
+    const = np.full(64, 7.0, np.float32)
+    c, e = st.histogram(st.from_numpy(const), bins=10)
+    rc, re = np.histogram(const, bins=10)
+    np.testing.assert_array_equal(np.asarray(c.glom()), rc)
+    np.testing.assert_allclose(np.asarray(e.glom()), re, rtol=1e-6)
+    c2, e2 = st.histogram(st.from_numpy(np.empty(0, np.float32)),
+                          bins=4)
+    rc2, re2 = np.histogram(np.empty(0), bins=4)
+    np.testing.assert_array_equal(np.asarray(c2.glom()), rc2)
+    np.testing.assert_allclose(np.asarray(e2.glom()), re2, rtol=1e-6)
+    # repeated identical explicit-range calls share one compiled program
+    from spartan_tpu.expr import base as base_mod
+
+    a = np.random.RandomState(34).rand(256).astype(np.float32)
+    st.histogram(st.from_numpy(a), bins=8, range=(0.0, 1.0))[0].glom()
+    size1 = len(base_mod._compile_cache)
+    st.histogram(st.from_numpy(a), bins=8, range=(0.0, 1.0))[0].glom()
+    assert len(base_mod._compile_cache) == size1
