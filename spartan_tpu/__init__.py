@@ -47,9 +47,20 @@ def initialize(argv=None):
     §3.1): parse flags, bring up the multi-host control plane when a
     cluster environment is present (``jax.distributed`` plays the
     reference master's registration/barrier role — SURVEY.md §2.7;
-    no-op standalone), and install the ambient mesh. The whole
-    master/worker bring-up otherwise collapses to mesh construction."""
+    no-op standalone), enable the persistent compilation cache when
+    configured, and install the ambient mesh. The whole master/worker
+    bring-up otherwise collapses to mesh construction."""
     rest = FLAGS.parse_args(argv)
+    cache_dir = getattr(FLAGS, "compilation_cache_dir", "")
+    if cache_dir:
+        # XLA programs (incl. the ~2-min Pallas-in-loop sparse
+        # compiles, docs/BENCH.md) persist across processes — the
+        # disk-level twin of the in-process structural compile cache
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # jax's own persistence floor (min_compile_time 1s) is left
+        # untouched — users tune it via jax config / env themselves
     _mesh.initialize_distributed()  # no-op unless COORDINATOR/SLURM env
     _mesh.get_mesh()
     return rest

@@ -548,78 +548,89 @@ def quantile(x, q, axis=None) -> Expr:
                       axis=axis)
 
 
+def _hist_edges(lo, hi, bins: int):
+    """The bin-edge formula BOTH the bucketing kernels and the
+    returned-edges exprs evaluate (in f32, on device) — one source, so
+    counts can never disagree with the edges the caller receives."""
+    lo = jnp.asarray(lo, jnp.float32)
+    hi = jnp.asarray(hi, jnp.float32)
+    return lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
+
+
+def _hist_expand(lo, hi):
+    """np.histogram's degenerate-range rule: all-equal data (or an
+    explicit lo == hi range) spans value +/- 0.5."""
+    return (jnp.where(hi > lo, lo, lo - 0.5),
+            jnp.where(hi > lo, hi, hi + 0.5))
+
+
 def histogram(x, bins: int = 10, range=None):
     """``np.histogram`` with STATIC bin count: (counts, edges).
 
     Distributed as bucketing (a searchsorted map over the sharded
     operand) + the bincount reduction; ``range`` defaults to the
     operand's (min, max) — computed in the same program when not
-    given. With an explicit ``range`` the edges are a host constant
-    (np.histogram semantics: values outside it are dropped)."""
+    given. With an explicit ``range`` values outside it are dropped
+    (np.histogram semantics); a degenerate range or constant data
+    expands value +/- 0.5 like numpy. Edges are f32 (no x64 on
+    device) and are computed by the same formula the bucketing kernel
+    uses, so exact-edge values land where the returned edges say."""
+    from .map2 import map2
+
     x = as_expr(x)
     bins = int(bins)
     if bins <= 0:
         raise ValueError(f"histogram needs bins >= 1, got {bins}")
-    if x.size == 0:
-        # np.histogram of an empty array: zero counts over (0, 1)
-        lo, hi = (float(range[0]), float(range[1])) \
-            if range is not None else (0.0, 1.0)
-        return (zeros((bins,), np.int32),
-                as_expr(np.linspace(lo, hi, bins + 1)
-                        .astype(np.float32)))
     if range is not None:
         lo, hi = float(range[0]), float(range[1])
-        if not lo < hi:
-            raise ValueError(f"histogram range {range} is empty")
-        # edges are f32 on device (no x64); captured as SCALARS so the
-        # kernel's compile-cache key repeats across calls (fn_key hashes
-        # closure cells — an ndarray capture would key by id and
+        if hi < lo:
+            raise ValueError(
+                f"histogram range {range}: max must be >= min")
+        if lo == hi:  # numpy expands the degenerate explicit range
+            lo, hi = lo - 0.5, hi + 0.5
+    if x.size == 0:
+        lo0, hi0 = (lo, hi) if range is not None else (0.0, 1.0)
+        return (zeros((bins,), np.int32),
+                as_expr(np.linspace(lo0, hi0, bins + 1)
+                        .astype(np.float32)))
+    if range is not None:
+        # lo/hi captured as SCALARS so the kernels' compile-cache keys
+        # repeat across calls (an ndarray capture would key by id and
         # recompile every call)
-        edges = as_expr(np.linspace(lo, hi, bins + 1)
-                        .astype(np.float32))
-
         def bucket(v, lo=lo, hi=hi, bins=bins):
-            e = jnp.linspace(jnp.float32(lo), jnp.float32(hi), bins + 1)
-            idx = jnp.searchsorted(e, v.astype(e.dtype),
-                                   side="right") - 1
+            e = _hist_edges(lo, hi, bins)
+            vv = v.astype(e.dtype)
+            idx = jnp.searchsorted(e, vv, side="right") - 1
             # np.histogram: the last bin is closed on the right
-            idx = jnp.where(v.astype(e.dtype) == e[-1], bins - 1, idx)
-            oob = (v.astype(e.dtype) < e[0]) | (v.astype(e.dtype)
-                                                > e[-1])
+            idx = jnp.where(vv == e[-1], bins - 1, idx)
+            oob = (vv < e[0]) | (vv > e[-1])
             return jnp.where(oob, bins, idx).astype(jnp.int32)
 
         counts = bincount(map_expr(bucket, x), length=bins)
+        edges = map2([as_expr(0.0)],
+                     lambda _z, lo=lo, hi=hi, bins=bins:
+                     _hist_edges(lo, hi, bins))
         return counts, edges
     # data-dependent range: min/max reductions feed the bucketing map
-    # inside one traced program (no host round trip). A degenerate
-    # range (all values equal) expands to value +/- 0.5, np.histogram
-    # style. f32 throughout: f64 is unavailable on-device without x64.
+    # inside one traced program (no host round trip)
     from .reduce import max as _rmax
     from .reduce import min as _rmin
 
     lo_e, hi_e = _rmin(x), _rmax(x)
 
     def bucket2(v, lo, hi):
-        # searchsorted on the same edges np.histogram uses (not a
-        # floor-div, whose f32 width rounding buckets exact-edge
-        # values one bin low)
-        lo = lo.astype(jnp.float32)
-        hi = hi.astype(jnp.float32)
-        lo, hi = (jnp.where(hi > lo, lo, lo - 0.5),
-                  jnp.where(hi > lo, hi, hi + 0.5))
-        e = lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
-        idx = jnp.searchsorted(e, v.astype(jnp.float32),
-                               side="right") - 1
+        lo, hi = _hist_expand(lo.astype(jnp.float32),
+                              hi.astype(jnp.float32))
+        e = _hist_edges(lo, hi, bins)
+        idx = jnp.searchsorted(e, v.astype(e.dtype), side="right") - 1
         return jnp.clip(idx, 0, bins - 1).astype(jnp.int32)
 
     counts = bincount(map_expr(bucket2, x, lo_e, hi_e), length=bins)
 
     def edges_fn(lo, hi):
-        lo = lo.astype(jnp.float32)
-        hi = hi.astype(jnp.float32)
-        lo, hi = (jnp.where(hi > lo, lo, lo - 0.5),
-                  jnp.where(hi > lo, hi, hi + 0.5))
-        return lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
+        lo, hi = _hist_expand(lo.astype(jnp.float32),
+                              hi.astype(jnp.float32))
+        return _hist_edges(lo, hi, bins)
 
     edges = map_expr(edges_fn, lo_e, hi_e)
     return counts, edges

@@ -170,6 +170,11 @@ FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
 FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force().")
 FLAGS.define_str("profile_dir", "/tmp/spartan_tpu_profile",
                  "Where profiler traces are written.")
+FLAGS.define_str(
+    "compilation_cache_dir", "",
+    "Enable JAX's persistent compilation cache at this path (empty = "
+    "off): compiled XLA programs survive process restarts, amortizing "
+    "long compiles like the Pallas-in-loop sparse iteration.")
 FLAGS.define_int("default_mesh_1d", 0,
                  "If >0, force the default mesh to this many devices.")
 FLAGS.define_str("placement", "auto",

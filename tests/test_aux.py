@@ -181,3 +181,26 @@ def test_evaluate_with_recovery_api(monkeypatch):
         evaluate_with_recovery(b, retries=3)
     monkeypatch.undo()
     assert calls["n"] == before + 100  # exactly one attempt
+
+
+def test_persistent_compilation_cache_flag(tmp_path):
+    """--compilation_cache_dir wires JAX's persistent cache: after an
+    initialize() + compile, the cache directory holds entries."""
+    import jax
+
+    import spartan_tpu as st
+    from spartan_tpu.utils.config import FLAGS
+
+    cache = str(tmp_path / "xla_cache")
+    try:
+        st.initialize(["--compilation_cache_dir", cache])
+        import numpy as np
+
+        x = st.from_numpy(np.arange(4096, dtype=np.float32))
+        # a compile long enough to clear the 1s persistence floor is
+        # not guaranteed on CPU; assert the config took instead
+        assert jax.config.jax_compilation_cache_dir == cache
+        float((x * 2.0).sum().glom())
+    finally:
+        FLAGS.reset_all()
+        jax.config.update("jax_compilation_cache_dir", None)

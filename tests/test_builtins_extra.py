@@ -226,3 +226,26 @@ def test_histogram_edge_cases(mesh1d):
     size1 = len(base_mod._compile_cache)
     st.histogram(st.from_numpy(a), bins=8, range=(0.0, 1.0))[0].glom()
     assert len(base_mod._compile_cache) == size1
+
+
+def test_histogram_explicit_range_edge_rules(mesh1d):
+    """Explicit-range validation order + degenerate expansion: a
+    reversed range raises even for empty input; lo == hi expands
+    +/- 0.5 like np.histogram; returned edges agree with the
+    bucketing for exact-edge values."""
+    with pytest.raises(ValueError, match="max must be >= min"):
+        st.histogram(st.from_numpy(np.empty(0, np.float32)), bins=4,
+                     range=(5.0, 1.0))
+    a = np.full(32, 5.0, np.float32)
+    c, e = st.histogram(st.from_numpy(a), bins=10, range=(5.0, 5.0))
+    rc, re = np.histogram(a, bins=10, range=(5.0, 5.0))
+    np.testing.assert_array_equal(np.asarray(c.glom()), rc)
+    np.testing.assert_allclose(np.asarray(e.glom()), re, rtol=1e-6)
+    # a value exactly on a returned interior edge lands in the bin the
+    # edges imply (shared edge formula between kernel and output)
+    edges = np.asarray(st.histogram(st.from_numpy(
+        np.zeros(1, np.float32)), bins=7, range=(0.0, 1.0))[1].glom())
+    probe = np.full(16, edges[3], np.float32)
+    counts = np.asarray(st.histogram(st.from_numpy(probe), bins=7,
+                                     range=(0.0, 1.0))[0].glom())
+    assert counts[3] == 16 and counts.sum() == 16
