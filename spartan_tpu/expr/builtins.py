@@ -554,7 +554,10 @@ def _hist_edges(lo, hi, bins: int):
     counts can never disagree with the edges the caller receives."""
     lo = jnp.asarray(lo, jnp.float32)
     hi = jnp.asarray(hi, jnp.float32)
-    return lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
+    # jnp.linspace pins BOTH endpoints exactly (it concatenates stop),
+    # so a value equal to the range max never rounds out of the
+    # closed last bin
+    return jnp.linspace(lo, hi, bins + 1)
 
 
 def _hist_expand(lo, hi):
@@ -583,9 +586,10 @@ def histogram(x, bins: int = 10, range=None):
         raise ValueError(f"histogram needs bins >= 1, got {bins}")
     if range is not None:
         lo, hi = float(range[0]), float(range[1])
-        if hi < lo:
+        if not (np.isfinite(lo) and np.isfinite(hi)) or hi < lo:
             raise ValueError(
-                f"histogram range {range}: max must be >= min")
+                f"histogram range {range}: bounds must be finite "
+                f"with max >= min")
         if lo == hi:  # numpy expands the degenerate explicit range
             lo, hi = lo - 0.5, hi + 0.5
     if x.size == 0:

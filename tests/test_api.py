@@ -83,3 +83,25 @@ def test_graft_entry_runs():
         g.dryrun_multichip(8)
     finally:
         sys.path.pop(0)
+
+
+def test_numpy_surface_complete():
+    """The SURVEY §2.3 builtins list plus the round-5 additions are
+    all reachable from the top-level namespace — the parity surface a
+    reference user would reach for."""
+    wanted = (
+        # SURVEY's named list
+        "zeros ones rand randn arange astype ravel sum mean max min "
+        "argmin argmax diag diagonal norm concatenate bincount tril "
+        "triu scan "
+        # operators / order statistics / contraction family
+        "sort argsort median percentile quantile histogram unique "
+        "unique_counts einsum tensordot matmul inner trace dot "
+        "cumsum cumprod var std ptp take where linspace "
+        # structure
+        "from_numpy shuffle loop map map2 outer filter reshape "
+        "transpose tuple_of dict_of build_mesh use_mesh initialize "
+        "Tiling"
+    ).split()
+    missing = [name for name in wanted if not hasattr(st, name)]
+    assert not missing, f"missing from spartan_tpu namespace: {missing}"

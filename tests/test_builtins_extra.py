@@ -233,7 +233,7 @@ def test_histogram_explicit_range_edge_rules(mesh1d):
     reversed range raises even for empty input; lo == hi expands
     +/- 0.5 like np.histogram; returned edges agree with the
     bucketing for exact-edge values."""
-    with pytest.raises(ValueError, match="max must be >= min"):
+    with pytest.raises(ValueError, match="max >= min"):
         st.histogram(st.from_numpy(np.empty(0, np.float32)), bins=4,
                      range=(5.0, 1.0))
     a = np.full(32, 5.0, np.float32)
@@ -249,3 +249,17 @@ def test_histogram_explicit_range_edge_rules(mesh1d):
     counts = np.asarray(st.histogram(st.from_numpy(probe), bins=7,
                                      range=(0.0, 1.0))[0].glom())
     assert counts[3] == 16 and counts.sum() == 16
+
+
+def test_histogram_range_max_and_nan_bounds(mesh1d):
+    """A value exactly equal to the range max lands in the closed
+    last bin (endpoint pinned exactly); NaN/inf range bounds raise."""
+    hi = 16.066476821899414
+    a = np.array([np.float32(hi)] * 8, np.float32)
+    c, e = st.histogram(st.from_numpy(a), bins=7,
+                        range=(-81.8493881225586, hi))
+    got = np.asarray(c.glom())
+    assert got[6] == 8 and got.sum() == 8
+    for bad in ((np.nan, 1.0), (0.0, np.inf)):
+        with pytest.raises(ValueError, match="finite"):
+            st.histogram(st.from_numpy(a), bins=4, range=bad)
