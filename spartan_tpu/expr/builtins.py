@@ -747,19 +747,27 @@ def einsum(subscripts: str, *operands, precision=None) -> Expr:
     Two-operand contractions (incl. ellipsis batching) build a planned
     ``ContractExpr`` — the smart-tiling pass searches output grids and
     contraction placements for them exactly as for 2-D GEMMs
-    (SURVEY.md §2.3 pass (d)). Specs outside that family (3+ operands,
-    diagonals, broadcasting ellipses) stay a single traced ``jnp.einsum``
+    (SURVEY.md §2.3 pass (d)). 3+ operands decompose into a CHAIN of
+    planned pairwise contractions along np.einsum_path's greedy order,
+    so every intermediate GEMM is planner-visible too. Specs outside
+    the family (diagonals, broadcasting ellipses, single-operand
+    reductions in the path) stay a single traced ``jnp.einsum``
     sharded by GSPMD from the operands' tilings."""
-    from .contract import contract, parse_einsum_2op
+    from .contract import contract, contract_chain, parse_einsum
     from .map2 import map2
 
     exprs = [as_expr(o) for o in operands]
-    if len(exprs) == 2:
-        labels = parse_einsum_2op(subscripts, exprs[0].ndim,
-                                  exprs[1].ndim)
-        if labels is not None:
-            e = contract(exprs[0], exprs[1], *labels,
-                         precision=precision)
+    parsed = parse_einsum(subscripts, tuple(e.ndim for e in exprs))
+    if parsed is not None:
+        per_op, out_labels = parsed
+        if len(exprs) == 2:
+            e = contract(exprs[0], exprs[1], per_op[0], per_op[1],
+                         out_labels, precision=precision)
+            if e is not None:
+                return e
+        elif len(exprs) > 2:
+            e = contract_chain(exprs, per_op, out_labels,
+                               precision=precision)
             if e is not None:
                 return e
     return map2(exprs,

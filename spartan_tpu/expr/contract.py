@@ -195,6 +195,52 @@ def canonicalize(per_operand: Sequence[Sequence[str]],
     return ops, tuple(rename(lab) for lab in out)
 
 
+def contract_chain(operands, per_op_labels, out_labels,
+                   precision=None):
+    """Decompose an N-operand einsum into a chain of planned pairwise
+    ContractExprs along np.einsum_path's greedy contraction order —
+    every intermediate GEMM gets a smart-tiling plan, where the traced
+    N-operand fallback is planner-invisible. Returns None when the
+    chain falls outside the pairwise family (single-operand
+    path steps, diagonals, broadcasting), letting the caller fall back
+    to the traced einsum. Operands must already be Exprs."""
+    ops = list(operands)
+    labels = [tuple(ls) for ls in per_op_labels]
+    out = tuple(out_labels)
+    if len(ops) < 2:
+        return None
+    spec = ",".join("".join(ls) for ls in labels) + "->" + "".join(out)
+    try:
+        # zero-copy dummies: einsum_path only reads shapes
+        dummies = [np.broadcast_to(np.float32(0), o.shape) for o in ops]
+        path = np.einsum_path(spec, *dummies, optimize="greedy")[0]
+    except Exception:
+        return None
+    for step in path[1:]:  # path[0] is the 'einsum_path' marker
+        if len(step) != 2:
+            return None  # single-operand reduction step: traced path
+        j, i = sorted(step, reverse=True)
+        a, la = ops.pop(j), labels.pop(j)
+        b, lb = ops.pop(i), labels.pop(i)
+        if not ops:  # final pair: the caller's output, in order
+            inter = out
+        else:
+            keep = set(out)
+            for ls in labels:
+                keep.update(ls)
+            seen = []
+            for lab in lb + la:
+                if lab in keep and lab not in seen:
+                    seen.append(lab)
+            inter = tuple(seen)
+        e = contract(b, a, lb, la, inter, precision=precision)
+        if e is None:
+            return None
+        ops.append(e)
+        labels.append(inter)
+    return ops[0]
+
+
 def parse_einsum_2op(subscripts: str, a_ndim: int, b_ndim: int
                      ) -> Optional[Tuple[Tuple[str, ...],
                                          Tuple[str, ...],
@@ -204,13 +250,28 @@ def parse_einsum_2op(subscripts: str, a_ndim: int, b_ndim: int
     for specs outside the planned family (the caller's traced-einsum
     fallback handles those): repeated labels in an operand, or
     ellipsis batch ranks that differ between operands or broadcast."""
+    parsed = parse_einsum(subscripts, (a_ndim, b_ndim))
+    if parsed is None:
+        return None
+    (ca, cb), co = parsed
+    return ca, cb, co
+
+
+def parse_einsum(subscripts: str, ndims
+                 ) -> Optional[Tuple[Tuple[Tuple[str, ...], ...],
+                                     Tuple[str, ...]]]:
+    """Parse an N-operand einsum spec into canonical per-axis label
+    tuples, expanding ellipses against the known ranks. Returns None
+    for specs outside the planned family (the caller's traced-einsum
+    fallback handles those): repeated labels within an operand, or
+    ellipsis batch ranks that differ between operands (broadcast)."""
     spec = subscripts.replace(" ", "")
     if "->" in spec:
         ins, out = spec.split("->", 1)
     else:
         ins, out = spec, None
     parts = ins.split(",")
-    if len(parts) != 2:
+    if len(parts) != len(ndims):
         return None
 
     def expand(part: str, ndim: int) -> Optional[Tuple[str, ...]]:
@@ -223,32 +284,34 @@ def parse_einsum_2op(subscripts: str, a_ndim: int, b_ndim: int
             return tuple(head) + ell + tuple(tail)
         return tuple(part) if len(part) == ndim else None
 
-    la = expand(parts[0], a_ndim)
-    lb = expand(parts[1], b_ndim)
-    if la is None or lb is None:
-        return None
-    n_ell_a = len([x for x in la if x.startswith("...")])
-    n_ell_b = len([x for x in lb if x.startswith("...")])
-    if n_ell_a and n_ell_b and n_ell_a != n_ell_b:
+    expanded = []
+    for part, nd in zip(parts, ndims):
+        ls = expand(part, nd)
+        if ls is None:
+            return None
+        expanded.append(ls)
+    ell_counts = {len([x for x in ls if x.startswith("...")])
+                  for ls in expanded}
+    ell_counts.discard(0)
+    if len(ell_counts) > 1:
         return None  # broadcasting ellipsis ranks: traced fallback
-    ell = [x for x in (la if n_ell_a >= n_ell_b else lb)
-           if x.startswith("...")]
+    n_ell = ell_counts.pop() if ell_counts else 0
+    ell = tuple(f"...{i}" for i in range(n_ell))
     if out is None:
         # implicit output: ellipsis dims then once-occurring labels in
         # alphabetical order (NumPy's rule)
         counts: Dict[str, int] = {}
-        for lab in tuple(parts[0].replace(".", "")) + \
-                tuple(parts[1].replace(".", "")):
-            counts[lab] = counts.get(lab, 0) + 1
-        lo = tuple(ell) + tuple(sorted(
+        for part in parts:
+            for lab in part.replace(".", ""):
+                counts[lab] = counts.get(lab, 0) + 1
+        lo = ell + tuple(sorted(
             lab for lab, c in counts.items() if c == 1))
     else:
         if "..." in out:
             head, _, tail = out.partition("...")
-            lo = tuple(head) + tuple(ell) + tuple(tail)
+            lo = tuple(head) + ell + tuple(tail)
         else:
             if ell:
                 return None  # einsum would error; let jnp raise it
             lo = tuple(out)
-    (ca, cb), co = canonicalize((la, lb), lo)
-    return ca, cb, co
+    return canonicalize(expanded, lo)
