@@ -314,4 +314,7 @@ def parse_einsum(subscripts: str, ndims
             if ell:
                 return None  # einsum would error; let jnp raise it
             lo = tuple(out)
-    return canonicalize(expanded, lo)
+    try:
+        return canonicalize(expanded, lo)
+    except ValueError:
+        return None  # >52 distinct labels: traced fallback handles it

@@ -72,6 +72,15 @@ try:
         with mesh_mod.use_mesh(mesh):
             checkpoint.save(os.environ["CKPT"],
                             DistArray(y, Tiling(("x", None)), mesh))
+            # sparse checkpoint through the same cross-process writer
+            from spartan_tpu.array.sparse import SparseDistArray
+
+            rng = np.random.RandomState(3)
+            r = rng.randint(0, 24, 100)
+            c = rng.randint(0, 20, 100)
+            v = rng.rand(100).astype(np.float32)
+            sp = SparseDistArray.from_coo(r, c, v, (24, 20))
+            checkpoint.save_sparse(os.environ["CKPT"] + "_sp", sp)
         print("CKPT_OK", flush=True)
     except Exception as e:  # checkpoint failures are not psum failures
         print("CKPT_FAIL", type(e).__name__, repr(e)[:300], flush=True)
@@ -98,8 +107,17 @@ mesh = mesh_mod.build_mesh(jax.devices(), shape=(8, 1))
 with mesh_mod.use_mesh(mesh):
     arr = checkpoint.load(os.environ["CKPT"])
     got = np.asarray(arr.glom())
-np.testing.assert_array_equal(
-    got, np.arange(32, dtype=np.float32).reshape(8, 4))
+    np.testing.assert_array_equal(
+        got, np.arange(32, dtype=np.float32).reshape(8, 4))
+    # sparse elastic load: device-resident, re-padded for this mesh
+    sp = checkpoint.load_sparse(os.environ["CKPT"] + "_sp")
+    rng = np.random.RandomState(3)
+    r = rng.randint(0, 24, 100)
+    c = rng.randint(0, 20, 100)
+    v = rng.rand(100).astype(np.float32)
+    oracle = np.zeros((24, 20), np.float32)
+    np.add.at(oracle, (r, c), v)
+    np.testing.assert_allclose(sp.glom(), oracle, rtol=1e-5)
 print("ELASTIC_LOAD_OK", flush=True)
 """
 
