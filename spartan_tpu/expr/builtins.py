@@ -29,7 +29,7 @@ __all__ = [
     "triu", "bincount", "concatenate", "ravel", "sqrt", "dot", "power",
     "equal", "from_numpy", "count_nonzero", "count_zero", "size", "scan",
     "sort", "argsort", "median", "percentile", "quantile", "histogram",
-    "unique_counts", "unique",
+    "unique_counts", "unique", "topk",
     "isnan", "isinf",
     "isfinite", "logical_not", "var", "std", "ptp", "cumsum", "cumprod",
     "take", "linspace", "log1p", "expm1", "log2", "log10", "floor", "ceil",
@@ -537,6 +537,73 @@ def percentile(x, q, axis=None) -> Expr:
     qq = float(qa[0]) if scalar_q else tuple(qa.tolist())
     return map_expr(
         lambda v: jnp.percentile(v, jnp.asarray(qq), axis=axis), x)
+
+
+class TopKExpr(Expr):
+    """INDICES of the distributed top-k (ops/sort.py
+    distributed_topk): per-shard ``lax.top_k`` candidates + one k*p
+    all_gather + final top-k — only candidates cross the wire. Values
+    are a k-element gather on top (builtins.topk), so one kernel
+    serves both outputs."""
+
+    def __init__(self, x: Expr, k: int, largest: bool):
+        self.x = x
+        self.k = int(k)
+        self.largest = bool(largest)
+        super().__init__((self.k,), np.dtype(np.int32))
+
+    def children(self):
+        return (self.x,)
+
+    def replace_children(self, new_children) -> "TopKExpr":
+        return TopKExpr(new_children[0], self.k, self.largest)
+
+    def _lower(self, env) -> Any:
+        from ..ops.sort import distributed_topk
+
+        return distributed_topk(self.x.lower(env), self.k,
+                                largest=self.largest)[1]
+
+    def _sig(self, ctx):
+        return ("topk", self.k, self.largest, ctx.of(self.x))
+
+    def _default_tiling(self):
+        from ..array import tiling as tiling_mod
+
+        return tiling_mod.replicated(1)
+
+
+def topk(x, k: int, largest: bool = True):
+    """(values, indices) of the k largest (or smallest) elements of a
+    1-D array, values best-first — ``lax.top_k`` at mesh scale. On a
+    multi-device mesh with ``k <= ceil(n/p)`` only ``k*p`` candidates
+    cross the wire (per-shard top-k + one gather); bigger k rides the
+    distributed sample argsort. Values are gathered through the
+    indices, so each variant runs ONE distributed kernel. Ties resolve
+    to any valid winner set (like ``lax.top_k``)."""
+    from ..parallel import mesh as mesh_mod
+
+    x = as_expr(x)
+    if x.ndim != 1:
+        raise ValueError(f"topk needs a 1-D operand, got {x.shape}")
+    k = int(k)
+    n = x.shape[0]
+    if not 1 <= k <= n:
+        raise ValueError(f"topk needs 1 <= k <= {n}, got {k}")
+    from ..array import tiling as tiling_mod
+    p = int(mesh_mod.get_mesh().shape.get(tiling_mod.AXIS_ROW, 1))
+    if p > 1 and k > -(-n // p):
+        # k exceeds the per-shard candidate budget: distributed
+        # argsort, then slice the winning end (best-first)
+        si = SampleSortExpr(x, indices=True)
+        if largest:
+            idx = map_expr(lambda v: v[::-1], si[n - k:])
+        else:
+            idx = si[:k]
+    else:
+        idx = TopKExpr(x, k, largest)
+    vals = map_expr(lambda v, i: v[i], x, idx)
+    return vals, idx
 
 
 def quantile(x, q, axis=None) -> Expr:

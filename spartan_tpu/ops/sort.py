@@ -281,3 +281,82 @@ def sample_sort_axis(x: jax.Array, mesh=None, with_indices: bool =
     (``jnp.argsort`` semantics)."""
     return _run(x, mesh, with_indices=with_indices,
                 in_tiling=in_tiling)
+
+
+def _extreme(dtype, lo: bool):
+    """The dtype's most extreme value (lo=True: minimum) — the sentinel
+    masking padded slots out of a top-k."""
+    dt = np.dtype(dtype)
+    if dt == np.bool_:
+        return np.bool_(not lo)
+    if np.issubdtype(dt, np.floating):
+        return dt.type(-np.inf if lo else np.inf)
+    info = np.iinfo(dt)
+    return dt.type(info.min if lo else info.max)
+
+
+def distributed_topk(x: jax.Array, k: int, largest: bool = True,
+                     mesh=None):
+    """(values, indices) of the k largest (or smallest) elements of a
+    1-D array, values sorted best-first — the reference-free analogue
+    of ``lax.top_k`` at mesh scale. Per shard: a LOCAL ``lax.top_k``
+    keeps k candidates; one ``all_gather`` moves the p*k candidates
+    (not the array); a final top-k picks the winners, replicated on
+    every device. Only k*p values + indices cross the wire. Requires
+    ``k <= ceil(n/p)`` (callers route bigger k through the full sort);
+    ragged lengths ride the same sentinel masking as the sample sort.
+    Smallest-k runs largest-k on the ORDER-FLIPPED key (sentinel
+    masked), so int dtypes need no negation."""
+    from jax import shard_map
+
+    mesh = mesh or mesh_mod.get_mesh()
+    axis = tiling_mod.AXIS_ROW
+    p = int(mesh.shape.get(axis, 1))
+    n = int(x.shape[0])
+    k = int(k)
+    if not 1 <= k <= n:
+        raise ValueError(f"topk needs 1 <= k <= {n}, got {k}")
+    if p <= 1:
+        _, idx = jax.lax.top_k(x if largest else _flip_key(x), k)
+        return x[idx], idx.astype(jnp.int32)
+    xp, m = _padded(x, n, p)
+    if k > m:
+        raise ValueError(
+            f"distributed_topk requires k <= shard size {m}; got {k}")
+    row = tiling_mod.row(1)
+    xp = jax.lax.with_sharding_constraint(xp, row.sharding(mesh))
+    sentinel = _extreme(x.dtype, lo=largest)
+
+    def kern(xs):
+        me = jax.lax.axis_index(axis)
+        gidx = me.astype(jnp.int32) * m + jnp.arange(
+            m, dtype=jnp.int32)
+        valid = gidx < n
+        vv = jnp.where(valid, xs, sentinel)
+        # smallest-k = largest-k on the flipped ranking key; the VALUE
+        # payload stays untransformed, so ints survive exactly
+        key = vv if largest else _flip_key(vv)
+        lk, li = jax.lax.top_k(key, k)
+        lv = vv[li]
+        gk = jax.lax.all_gather(lk, axis, tiled=True)       # (p*k,)
+        gv = jax.lax.all_gather(lv, axis, tiled=True)
+        gi = jax.lax.all_gather(gidx[li], axis, tiled=True)
+        _, win = jax.lax.top_k(gk, k)
+        return gv[win][None], gi[win][None].astype(jnp.int32)
+
+    mapped = shard_map(
+        kern, mesh=mesh, in_specs=(row.spec(),),
+        out_specs=(tiling_mod.Tiling((axis, None)).spec(),) * 2)
+    vals, idx = mapped(xp)
+    # every shard computed the same winners: shard 0's row is the answer
+    return vals[0], idx[0]
+
+
+def _flip_key(v: jax.Array) -> jax.Array:
+    """An order-reversing, order-preserving-under-top_k transform:
+    floats negate; ints flip via bitwise NOT against the unsigned
+    midpoint (exact for the whole range, INT_MIN included)."""
+    if np.issubdtype(np.dtype(v.dtype), np.floating):
+        return -v
+    return jnp.invert(v)
+

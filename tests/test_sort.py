@@ -468,3 +468,39 @@ def test_median_ragged(mesh1d):
         fa = st.from_numpy(a)
         np.testing.assert_allclose(float(st.median(fa).glom()),
                                    np.median(a), rtol=1e-6)
+
+
+def test_topk_distributed(mesh1d):
+    """Distributed top-k: candidate path (k <= shard) and the
+    argsort-slice path (k > shard), largest and smallest, ints and
+    floats, ragged length."""
+    rng = np.random.RandomState(17)
+    for n in (8192, 1001):
+        a = rng.rand(n).astype(np.float32)
+        fa = st.from_numpy(a) if n % 8 else st.from_numpy(
+            a, tiling=tiling.row(1))
+        for k in (1, 5, 64):
+            for largest in (True, False):
+                vals, idx = st.topk(fa, k, largest=largest)
+                gv, gi = np.asarray(vals.glom()), np.asarray(idx.glom())
+                ref = np.sort(a)[::-1][:k] if largest else np.sort(a)[:k]
+                np.testing.assert_allclose(gv, ref, rtol=1e-6)
+                np.testing.assert_allclose(a[gi], gv, rtol=1e-6)
+                assert gi.dtype == np.int32
+                assert len(set(gi.tolist())) == k  # distinct winners
+    # k > shard budget: the argsort-slice path
+    b = rng.rand(800).astype(np.float32)  # shard = 100
+    vals, idx = st.topk(st.from_numpy(b, tiling=tiling.row(1)), 300)
+    np.testing.assert_allclose(np.asarray(vals.glom()),
+                               np.sort(b)[::-1][:300], rtol=1e-6)
+    # ints incl. extremes survive the order-flip (no negation overflow)
+    c = rng.randint(-2**31, 2**31 - 1, 4096).astype(np.int32)
+    c[0] = np.iinfo(np.int32).min
+    c[1] = np.iinfo(np.int32).max
+    fc = st.from_numpy(c, tiling=tiling.row(1))
+    for largest in (True, False):
+        gv = np.asarray(st.topk(fc, 7, largest=largest)[0].glom())
+        ref = np.sort(c)[::-1][:7] if largest else np.sort(c)[:7]
+        np.testing.assert_array_equal(gv, ref)
+    with pytest.raises(ValueError, match="1 <= k"):
+        st.topk(fc, 0)
