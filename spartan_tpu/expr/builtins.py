@@ -391,7 +391,12 @@ def sort(x, axis: int = -1) -> Expr:
     channel) and any rank (the kernel vmaps over non-sort axes).
     Everything else is a single traced ``jnp.sort`` over the sharded
     operand (XLA bitonic sort; right when the sort axis is local).
-    Masked operands sort valid-first, masked-last (numpy.ma)."""
+    Masked operands sort valid-first, masked-last (numpy.ma).
+
+    Note: when the sorted length does not divide the mesh the RESULT
+    materializes replicated (the DistArray layer's shard grid needs
+    even splits) — the sort itself still runs distributed; only the
+    final layout is replicated."""
     from ..array.masked import MaskedDistArray, masked_sort
 
     if isinstance(x, MaskedDistArray):

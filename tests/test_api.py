@@ -95,7 +95,7 @@ def test_numpy_surface_complete():
         "argmin argmax diag diagonal norm concatenate bincount tril "
         "triu scan "
         # operators / order statistics / contraction family
-        "sort argsort median percentile quantile histogram unique "
+        "sort argsort median percentile quantile histogram unique topk "
         "unique_counts einsum tensordot matmul inner trace dot "
         "cumsum cumprod var std ptp take where linspace "
         # structure
