@@ -202,6 +202,12 @@ def test_histogram_oracle(mesh1d):
     cb, eb = st.histogram(st.from_numpy(b.astype(np.int32)), bins=10)
     rcb, reb = np.histogram(b, bins=10)
     np.testing.assert_array_equal(np.asarray(cb.glom()), rcb)
+    # N-d input flattens (np.histogram semantics)
+    m2 = rng.rand(16, 32).astype(np.float32)
+    c2d, _ = st.histogram(st.from_numpy(m2), bins=8, range=(0.0, 1.0))
+    np.testing.assert_array_equal(
+        np.asarray(c2d.glom()),
+        np.histogram(m2, bins=8, range=(0.0, 1.0))[0])
 
 
 def test_histogram_edge_cases(mesh1d):
