@@ -773,9 +773,19 @@ def take(x, indices, axis=None) -> Expr:
 
     Indices enter the DAG as an input (not a closure capture) so the
     structural compile cache keys them by shape/dtype and the gather
-    program is reused across different index arrays."""
-    idx = as_expr(np.asarray(indices))
-    return map_expr(lambda v, i: jnp.take(v, i, axis=axis), as_expr(x), idx)
+    program is reused across different index arrays. Out-of-range
+    indices raise up front, numpy-style (the traced gather would
+    silently clamp them)."""
+    x = as_expr(x)
+    idx_np = np.asarray(indices)
+    bound = x.size if axis is None else \
+        x.shape[_checked_axis(int(axis), x.ndim)]
+    if idx_np.size and (idx_np.min() < -bound or idx_np.max() >= bound):
+        raise IndexError(
+            f"take indices out of bounds for axis size {bound}: "
+            f"range [{idx_np.min()}, {idx_np.max()}]")
+    idx = as_expr(idx_np)
+    return map_expr(lambda v, i: jnp.take(v, i, axis=axis), x, idx)
 
 
 def var(x, axis=None, ddof: int = 0, keepdims: bool = False) -> Expr:
@@ -878,6 +888,10 @@ def tensordot(a, b, axes=2) -> Expr:
                 f"{len(ax_a)} vs {len(ax_b)}")
     else:
         k = int(axes)
+        if k > a.ndim or k > b.ndim:
+            raise ValueError(
+                f"tensordot axes={k} exceeds operand ranks "
+                f"{a.ndim} and {b.ndim}")
         ax_a = tuple(range(a.ndim - k, a.ndim))
         ax_b = tuple(range(k))
     la = [_CANON[i] for i in range(a.ndim)]

@@ -269,3 +269,25 @@ def test_histogram_range_max_and_nan_bounds(mesh1d):
     for bad in ((np.nan, 1.0), (0.0, np.inf)):
         with pytest.raises(ValueError, match="finite"):
             st.histogram(st.from_numpy(a), bins=4, range=bad)
+
+
+def test_take_and_tensordot_validate():
+    """Out-of-range take indices and over-rank tensordot axes raise
+    clearly, numpy-style, instead of clamping or an opaque IndexError
+    (round-5 misuse audit)."""
+    x, ex = _np_pair(seed=40)
+    with pytest.raises(IndexError, match="out of bounds"):
+        st.take(ex, [100], axis=0)
+    with pytest.raises(IndexError, match="out of bounds"):
+        st.take(ex, [-9], axis=1)
+    # negative indices in range still work (numpy semantics)
+    np.testing.assert_allclose(
+        np.asarray(st.take(ex, [-1, 0], axis=0).glom()),
+        np.take(x, [-1, 0], axis=0), rtol=1e-6)
+    with pytest.raises(ValueError, match="exceeds operand ranks"):
+        st.tensordot(ex, ex, axes=3)
+
+
+def test_take_scalar_axis_errors():
+    with pytest.raises(ValueError, match="out of range"):
+        st.take(st.from_numpy(np.float32(3.0)), [0], axis=0)
