@@ -778,6 +778,9 @@ def take(x, indices, axis=None) -> Expr:
     silently clamp them)."""
     x = as_expr(x)
     idx_np = np.asarray(indices)
+    if axis is not None and x.ndim == 0:
+        raise ValueError(
+            f"take axis {axis} out of range for a 0-d operand")
     bound = x.size if axis is None else \
         x.shape[_checked_axis(int(axis), x.ndim)]
     if idx_np.size and (idx_np.min() < -bound or idx_np.max() >= bound):
