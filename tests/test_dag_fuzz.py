@@ -1,0 +1,108 @@
+"""Random expression-DAG fuzz: compose random chains of the core ops
+(elementwise, reductions, transpose, slice, dot) over random shapes
+and tilings, run them through the FULL pipeline — optimizer passes,
+smart tiling, GSPMD lowering — and compare against a numpy twin built
+alongside. The broadest single check that fusion + planning never
+change semantics (SURVEY.md §4: NumPy is the universal oracle)."""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling as tiling_mod
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+_TILINGS = [tiling_mod.row(2), tiling_mod.col(2), tiling_mod.block(2),
+            tiling_mod.row_t(2), tiling_mod.replicated(2)]
+
+
+def _rand_operand(rng):
+    shape = (int(rng.choice([4, 8, 12, 16])),
+             int(rng.choice([4, 8, 12, 16])))
+    a = rng.uniform(0.5, 2.0, shape).astype(np.float32)  # log-safe
+    t = tiling_mod.sanitize(_TILINGS[rng.randint(len(_TILINGS))], shape)
+    return a, st.from_numpy(a, tiling=t)
+
+
+def _step(rng, n, e):
+    """One random op applied to (numpy twin, expr twin)."""
+    op = rng.randint(7)
+    if op == 0:  # elementwise unary
+        f = rng.randint(3)
+        if f == 0:
+            return np.log1p(n), st.log1p(e)
+        if f == 1:
+            return np.abs(n), st.abs(e)
+        return np.tanh(n), st.tanh(e)
+    if op == 1:  # elementwise binary with a same-shape random operand
+        b = rng.uniform(0.5, 2.0, n.shape).astype(np.float32)
+        if b.ndim == 1:
+            t = (tiling_mod.row(1) if rng.rand() < 0.5
+                 else tiling_mod.replicated(1))
+        else:
+            t = _TILINGS[rng.randint(len(_TILINGS))]
+        eb = st.from_numpy(b, tiling=tiling_mod.sanitize(t, b.shape))
+        return (n + b, e + eb) if rng.rand() < 0.5 else (n * b, e * eb)
+    if op == 2:  # scalar arithmetic
+        s = float(rng.uniform(0.5, 2.0))
+        return n * s + 1.0, e * s + 1.0
+    if op == 3 and n.ndim == 2:  # transpose
+        return n.T, e.T
+    if op == 4 and n.ndim == 2 and n.shape[0] >= 4:  # slice rows
+        k = n.shape[0] // 2
+        return n[:k], e[:k]
+    if op == 5 and n.ndim == 2 and n.shape[0] == n.shape[1]:  # dot
+        return n @ n, st.dot(e, e)
+    if op == 6 and n.ndim == 2:  # partial reduction (keeps 1-D alive)
+        ax = int(rng.randint(2))
+        return n.sum(axis=ax), st.sum(e, axis=ax)
+    return n, e  # op inapplicable to this shape: identity
+
+
+def test_random_dags_match_numpy():
+    rng = np.random.RandomState(123)
+    for trial in range(30):
+        n, e = _rand_operand(rng)
+        depth = rng.randint(3, 9)
+        for _ in range(depth):
+            n, e = _step(rng, n, e)
+        got = np.asarray(e.optimized().glom())
+        np.testing.assert_allclose(
+            got, n, rtol=5e-3, atol=1e-4,
+            err_msg=f"trial {trial} shape {n.shape}")
+
+
+def test_random_dags_toggle_invariant():
+    """The same random DAGs with every optimizer pass DISABLED produce
+    the same results — passes change programs, never values."""
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(321)
+    for trial in range(8):
+        seed = int(rng.randint(1 << 30))
+
+        def build():
+            r = np.random.RandomState(seed)
+            n, e = _rand_operand(r)
+            for _ in range(r.randint(3, 7)):
+                n, e = _step(r, n, e)
+            return n, e
+
+        try:
+            FLAGS.opt_map_fusion = False
+            FLAGS.opt_reduce_fusion = False
+            FLAGS.opt_auto_tiling = False
+            FLAGS.opt_collapse_cached = False
+            _, e_off = build()
+            off = np.asarray(e_off.glom())
+        finally:
+            FLAGS.reset_all()
+        n_ref, e_on = build()
+        on = np.asarray(e_on.glom())
+        np.testing.assert_allclose(on, off, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(on, n_ref, rtol=5e-3, atol=1e-4)
