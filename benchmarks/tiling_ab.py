@@ -2,20 +2,22 @@
 how fast the canonical chain runs? (SURVEY.md §6 ablation requirement.)
 
 Chain: ``dot(A, B)`` with both operands row-sharded on the *col* mesh
-axis (row_t) — the combo where the 16-combo HLO census shows explicit
-planning beating GSPMD's negotiation: the pass routes the GEMM onto the
-transposed block grid (3 all-gathers), while unplanned GSPMD emits
-collective-permutes + all-reduces and warns about an involuntary full
-rematerialization.  On every other operand-layout combo the census
-shows ON == OFF (the plan coincides with GSPMD's and no constraint is
-emitted), so this is the honest demonstration case, not a cherry-picked
-regression.  Reports, per arm: wall time (result materialized in its
-sharded layout, no fetch) and the collective-op census of the compiled
-HLO.
+axis (row_t). Round-5 behavior (receive-bytes + FLOP-priced model):
+the pass routes this GEMM onto the psum row arm — the arm the
+measured-arm sweep shows fastest (pick_vs_best 1.00,
+tiling_sweep.json) — and the ON arm measures ~1.07-1.2x faster than
+unplanned GSPMD at n=2048/512 on the CPU mesh even though the
+collective-op CENSUS coincides (the constraints change where the
+collectives sit relative to the matmul, not their count). The
+--sweep mode is the primary validation surface: it forces EVERY
+candidate plan of 10 layout combos as measured arms and checks the
+model's pick lands within 20% of the best; this A/B remains the
+quick ablation smoke. Reports, per arm: wall time (result
+materialized in its sharded layout, no fetch) and the census.
 
 Run on the 8-virtual-device CPU mesh:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python benchmarks/tiling_ab.py [--small]
+      python benchmarks/tiling_ab.py [--small|--sweep]
 """
 
 from __future__ import annotations
