@@ -88,7 +88,9 @@ def _parallelism(t: Tiling, mesh) -> int:
 def candidates(node: Expr, mesh) -> List[Tiling]:
     """Candidate output tilings for a node (divisible ones only):
     row / col / block plus their mesh-axis-swapped (transposed)
-    variants, and replicated."""
+    variants, replicated, and — for rank >= 3 (batched contractions)
+    — every single-axis placement on the TRAILING axes too, which the
+    leading-axes-only vocabulary above cannot express."""
     nd = node.ndim
     cands = {tiling_mod.replicated(nd)}
     if nd >= 1:
@@ -103,6 +105,21 @@ def candidates(node: Expr, mesh) -> List[Tiling]:
         if (mesh.shape.get(tiling_mod.AXIS_ROW, 1) > 1
                 and mesh.shape.get(tiling_mod.AXIS_COL, 1) > 1):
             cands.add(tiling_mod.block_t(nd))
+    rep = tiling_mod.replicated(nd)
+    for i in range(2, nd):
+        for ax in (tiling_mod.AXIS_ROW, tiling_mod.AXIS_COL):
+            if mesh.shape.get(ax, 1) <= 1:
+                continue
+            cands.add(rep.with_axis(i, ax))
+            other = (tiling_mod.AXIS_COL if ax == tiling_mod.AXIS_ROW
+                     else tiling_mod.AXIS_ROW)
+            if mesh.shape.get(other, 1) > 1:
+                # pair placements: batch-row + trailing (dp x tp) AND
+                # the two trailing-most axes together (within-batch
+                # block — survives an indivisible batch axis)
+                cands.add(rep.with_axis(0, other).with_axis(i, ax))
+                cands.add(rep.with_axis(nd - 2, other)
+                          .with_axis(nd - 1, ax))
     out = []
     for t in cands:
         if tiling_mod.sanitize(t, node.shape, mesh) == t:
