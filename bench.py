@@ -104,6 +104,23 @@ def _fix_platform():
     return jax
 
 
+def _plan_diag() -> dict:
+    """Plan-cache hit/miss counters and per-phase host timers for the
+    stage's JSON line + a stderr diagnostic (utils/profiling): a
+    steady-state stage must show hit_rate ~1.0 and near-zero optimize
+    time — the dispatch-bound contract of the plan cache."""
+    from spartan_tpu.utils import profiling
+
+    stats = profiling.plan_cache_stats()
+    phases = {name: round(sec * 1e3, 2)
+              for name, sec in sorted(profiling.phase_seconds().items())}
+    print(f"[bench] plan cache: hits={stats['plan_hits']} "
+          f"misses={stats['plan_misses']} compiles={stats['compiles']} "
+          f"phase_ms={phases}", file=sys.stderr)
+    return {"hits": stats["plan_hits"], "misses": stats["plan_misses"],
+            "compiles": stats["compiles"], "phase_ms": phases}
+
+
 def worker_dot(k: int, reps: int, precision: str | None) -> None:
     """Measure the dot chain at loop length k; print one JSON line."""
     import numpy as np
@@ -125,6 +142,7 @@ def worker_dot(k: int, reps: int, precision: str | None) -> None:
     run(k)  # warmup at the same k: compiles once; reps hit the cache
     best = min(run(k) for _ in range(reps))
     gflops = 2.0 * N * N * N * k / best / 1e9
+    plan = _plan_diag()
     if precision == "highest":
         prec_label = "f32_highest"
     elif platform == "tpu":
@@ -139,6 +157,7 @@ def worker_dot(k: int, reps: int, precision: str | None) -> None:
         "platform": platform,
         "precision": prec_label,
         "loop_k": k,
+        "plan_cache": plan,
     }), flush=True)
 
 
@@ -198,6 +217,7 @@ def worker_kmeans(iters: int, reps: int) -> None:
         "unit": "iters/s",
         "platform": platform,
         "iters": iters,
+        "plan_cache": _plan_diag(),
     }), flush=True)
 
 

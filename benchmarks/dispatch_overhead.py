@@ -1,0 +1,124 @@
+"""Steady-state cached-``evaluate()`` host overhead for a k-means-step
+DAG, with the plan cache ON vs OFF — the dispatch-bound acceptance gate
+of the plan-cache PR.
+
+Each "iteration" rebuilds the k-means-step DAG from scratch (the
+iterative-driver shape: fresh Expr objects every step, structurally
+identical) and forces it. With the plan cache OFF every force re-runs
+the optimizer stack (three DAG rewrites + the smart-tiling ICI cost
+model) and re-signs the optimized DAG; ON, a force is one raw
+traversal + arg gather + dispatch. Host overhead is measured from the
+evaluate() phase timers (utils/profiling): everything EXCEPT the
+``dispatch``/``compile`` phases — i.e. the Python-side planning cost
+the plan cache exists to eliminate — so the reported speedup is not
+diluted by device time or by the jitted-call overhead common to both
+paths.
+
+Prints ONE JSON line:
+
+    {"metric": "dispatch_overhead", "host_overhead_us_plan_cache": ...,
+     "host_overhead_us_legacy": ..., "speedup": ..., ...}
+
+``speedup`` >= 5x is the committed regression floor
+(benchmarks/thresholds.json, graded by benchmarks/run_all.py).
+
+Usage: python benchmarks/dispatch_overhead.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PLAN_PHASES = ("sign", "optimize", "build")  # host-side planning work
+
+
+def _host_overhead_seconds(before: dict, after: dict) -> float:
+    return sum(after.get(p, 0.0) - before.get(p, 0.0)
+               for p in _PLAN_PHASES)
+
+
+def measure(iters: int = 20, n: int = 4096, d: int = 32, k: int = 16,
+            donate: bool = True) -> dict:
+    """Run the ON/OFF comparison; returns the metrics dict."""
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr.base import ValExpr, evaluate
+    from spartan_tpu.utils import profiling
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c0 = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+    # warmup: reach the steady-state centers tiling AND compile once,
+    # so both measured modes run against a hot compile cache
+    c = kmeans_step(pts, ValExpr(c0), k).evaluate()
+    c = kmeans_step(pts, ValExpr(c), k).evaluate()
+
+    def run_mode(plan_cache_on: bool, c):
+        FLAGS.plan_cache = plan_cache_on
+        before = profiling.phase_seconds()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            c = kmeans_step(pts, ValExpr(c), k).evaluate()
+        c.glom()  # force completion before reading the clock
+        wall = time.perf_counter() - t0
+        over = _host_overhead_seconds(before, profiling.phase_seconds())
+        return wall, over, c
+
+    counters0 = profiling.counters()
+    try:
+        wall_on, over_on, c = run_mode(True, c)
+        wall_off, over_off, c = run_mode(False, c)
+    finally:
+        FLAGS.plan_cache = True
+    counters1 = profiling.counters()
+    hits = (counters1.get("plan_hits", 0) - counters0.get("plan_hits", 0))
+    misses = (counters1.get("plan_misses", 0)
+              - counters0.get("plan_misses", 0))
+
+    out = {
+        "metric": "dispatch_overhead",
+        "iters": iters,
+        "shape": [n, d, k],
+        "host_overhead_us_plan_cache": round(over_on / iters * 1e6, 1),
+        "host_overhead_us_legacy": round(over_off / iters * 1e6, 1),
+        "wall_us_per_iter_plan_cache": round(wall_on / iters * 1e6, 1),
+        "wall_us_per_iter_legacy": round(wall_off / iters * 1e6, 1),
+        "speedup": round(over_off / over_on, 2) if over_on > 0 else None,
+        "plan_hits": hits,
+        "plan_misses": misses,
+    }
+
+    if donate:
+        # loop-carry donation on the same steady-state step: the old
+        # centers feed the dispatch that replaces them
+        FLAGS.plan_cache = True
+        # warmup compiles the donate_argnums executable variant
+        c = evaluate(kmeans_step(pts, ValExpr(c), k), donate=[c])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            c = evaluate(kmeans_step(pts, ValExpr(c), k), donate=[c])
+        c.glom()
+        out["wall_us_per_iter_donating"] = round(
+            (time.perf_counter() - t0) / iters * 1e6, 1)
+    return out
+
+
+def main() -> None:
+    iters = 20
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    out = measure(iters=iters, n=512 if small else 4096)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -171,11 +171,22 @@ def config5_sparse(st):
             "ssvd_seconds": ssvd_t, "ssvd_shape": [m_rows, 512]}
 
 
+def dispatch_overhead(st):
+    """Steady-state cached-evaluate() host overhead, plan cache on vs
+    off (benchmarks/dispatch_overhead.py): the planner-elimination
+    floor of the plan-cache PR."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import dispatch_overhead as do
+
+    return do.measure(iters=20, n=512 if SMALL else 4096)
+
+
 def guard_metrics(report) -> dict:
     """The dispatch-amortized metrics the regression guard grades —
     fused/looped forms chosen because per-dispatch timings swing ~2x
     with tunnel congestion (docs/BENCH.md round-4 note) while
-    amortized loops stay stable."""
+    amortized loops stay stable. ``dispatch_overhead_speedup`` is
+    host-side planning time, stable on any platform."""
     c3, c4, c5 = (report["config3_kmeans"], report["config4_logreg"],
                   report["config5_sparse"])
     km = c3.get("sec_per_iter_fused", c3["sec_per_iter"])
@@ -184,6 +195,8 @@ def guard_metrics(report) -> dict:
         "logreg_iters_per_sec": 1.0 / c4["sec_per_iter_fused"],
         "pagerank_iters_per_sec": 1.0 / c5["pagerank_sec_per_iter"],
         "ssvd_seconds": c5["ssvd_seconds"],
+        "dispatch_overhead_speedup":
+            report["dispatch_overhead"].get("speedup"),
     }
 
 
@@ -203,6 +216,7 @@ def main():
         "config3_kmeans": config3_kmeans(st),
         "config4_logreg": config4_logreg(st),
         "config5_sparse": config5_sparse(st),
+        "dispatch_overhead": dispatch_overhead(st),
     }
     metrics = guard_metrics(report)
     if not SMALL:

@@ -58,16 +58,55 @@ class DistArray:
     """A distributed N-d array: ``jax.Array`` + :class:`Tiling` over the
     ambient mesh."""
 
-    __slots__ = ("jax_array", "tiling", "mesh")
+    __slots__ = ("_jax", "tiling", "mesh", "_donate_next")
 
     def __init__(self, jax_array: jax.Array, tiling: Tiling,
                  mesh: Optional[Mesh] = None):
         if tiling.ndim != jax_array.ndim:
             raise ValueError(
                 f"tiling rank {tiling.ndim} != array rank {jax_array.ndim}")
-        self.jax_array = jax_array
+        self._jax = jax_array
+        self._donate_next = False
         self.tiling = tiling
         self.mesh = mesh or mesh_mod.get_mesh()
+
+    # -- buffer donation (expr/base.py evaluate(donate=...)) ------------
+
+    @property
+    def jax_array(self) -> jax.Array:
+        arr = self._jax
+        if arr is None:
+            raise RuntimeError(
+                "DistArray used after donation: its device buffer was "
+                "released to an evaluate(donate=...) / .donate() "
+                "dispatch; rebuild the array (or keep a copy) instead "
+                "of reusing the donated handle")
+        return arr
+
+    @jax_array.setter
+    def jax_array(self, value: jax.Array) -> None:
+        self._jax = value
+
+    def donate(self) -> "DistArray":
+        """Release this array's buffer to the NEXT ``evaluate()`` that
+        consumes it as a leaf: the executable is compiled as a
+        ``donate_argnums`` variant so XLA may alias the buffer into the
+        outputs (the loop-carry re-feed pattern — old centers/weights
+        feed the step that produces their replacement), and this
+        DistArray is invalidated after the dispatch so use-after-donate
+        raises cleanly instead of reading freed HBM. Returns ``self``
+        for call-site chaining: ``evaluate(step(c.donate()))``."""
+        self._donate_next = True
+        return self
+
+    @property
+    def is_donated(self) -> bool:
+        return self._jax is None
+
+    def _release_donated(self) -> None:
+        """Called by the evaluate() dispatch after a donating run."""
+        self._jax = None
+        self._donate_next = False
 
     # -- basic properties ----------------------------------------------
 
@@ -169,7 +208,7 @@ class DistArray:
         """Apply a shape-preserving jax-traceable fn to every shard
         independently (owner-computes, no communication) — the analogue of
         ``foreach_tile`` (SURVEY.md §2.2) for traceable kernels."""
-        from jax import shard_map
+        from ..utils.compat import shard_map
 
         spec = self.tiling.spec()
         mapped = shard_map(fn, mesh=self.mesh, in_specs=(spec,),

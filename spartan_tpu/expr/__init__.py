@@ -1,8 +1,9 @@
 """Public lazy-expression API (the reference's ``spartan.expr`` surface)."""
 
 from .base import (DictExpr, Expr, ListExpr, ScalarExpr, TupleExpr, ValExpr,
-                   as_expr, clear_compile_cache, compile_cache_size, dict_of,
-                   evaluate, lazify, tuple_of)
+                   as_expr, clear_compile_cache, clear_plan_cache,
+                   compile_cache_size, dict_of, evaluate, lazify,
+                   plan_cache_size, tuple_of)
 from .fio import from_file, load, save
 from .builtins import *  # noqa: F401,F403
 from .builtins import __all__ as _builtin_all
@@ -27,6 +28,7 @@ __all__ = ["Expr", "ValExpr", "ScalarExpr", "TupleExpr", "tuple_of",
            "optimize", "dag_nodes", "map", "map_with_location", "MapExpr",
            "ReduceExpr", "GeneralReduceExpr", "CreateExpr", "RandomExpr",
            "compile_cache_size", "clear_compile_cache",
+           "plan_cache_size", "clear_plan_cache",
            "assign", "write_array", "WriteExpr", "dot", "dot_shardmap",
            "DotExpr", "filter", "GatherExpr", "map2", "shard_map2",
            "Map2Expr", "ShardMap2Expr", "outer", "OuterExpr", "shuffle",

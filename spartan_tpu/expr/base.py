@@ -17,6 +17,8 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,6 +30,7 @@ from ..array import tiling as tiling_mod
 from ..array.distarray import DistArray
 from ..array.tiling import Tiling
 from ..parallel import mesh as mesh_mod
+from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from ..utils.log import log_debug
 
@@ -135,11 +138,18 @@ class Expr:
                 val = self._lower(env)
             except Exception as e:
                 if self._site and not getattr(e, "_expr_annotated", False):
-                    e._expr_annotated = True  # annotate innermost only
-                    e.add_note(
-                        f"while evaluating {type(self).__name__} built at "
-                        f"{self._site[0]}:{self._site[1]} "
-                        f"(in {self._site[2]})")
+                    try:
+                        e._expr_annotated = True  # annotate innermost only
+                        note = (
+                            f"while evaluating {type(self).__name__} built "
+                            f"at {self._site[0]}:{self._site[1]} "
+                            f"(in {self._site[2]})")
+                        if hasattr(e, "add_note"):
+                            e.add_note(note)
+                        else:  # Python < 3.11: emulate PEP 678 notes
+                            e.__notes__ = getattr(e, "__notes__", []) + [note]
+                    except Exception:
+                        pass  # slotted/frozen exceptions: keep the original
                 raise
             if self._forced_tiling is not None:
                 # smart-tiling chose this node's layout: constrain it so
@@ -165,11 +175,11 @@ class Expr:
 
     # -- evaluation -----------------------------------------------------
 
-    def evaluate(self) -> DistArray:
-        return evaluate(self)
+    def evaluate(self, donate: Sequence[Any] = ()) -> DistArray:
+        return evaluate(self, donate=donate)
 
-    def force(self) -> DistArray:
-        return evaluate(self)
+    def force(self, donate: Sequence[Any] = ()) -> DistArray:
+        return evaluate(self, donate=donate)
 
     def optimized(self) -> "Expr":
         from .optimize import optimize
@@ -507,11 +517,13 @@ class TupleExpr(Expr):
     def __len__(self) -> int:
         return len(self.elements)
 
-    def evaluate(self) -> Tuple[DistArray, ...]:  # type: ignore[override]
-        return evaluate(self)
+    def evaluate(self, donate: Sequence[Any] = ()
+                 ) -> Tuple[DistArray, ...]:  # type: ignore[override]
+        return evaluate(self, donate=donate)
 
-    def force(self) -> Tuple[DistArray, ...]:  # type: ignore[override]
-        return evaluate(self)
+    def force(self, donate: Sequence[Any] = ()
+              ) -> Tuple[DistArray, ...]:  # type: ignore[override]
+        return evaluate(self, donate=donate)
 
     def glom(self):  # type: ignore[override]
         return tuple(r.glom() for r in evaluate(self))
@@ -553,12 +565,12 @@ class DictExpr(Expr):
     def _sig(self, ctx: "_SigCtx") -> Tuple:
         return ("dict", self._keys, ctx.of(self._tuple))
 
-    def evaluate(self):  # type: ignore[override]
-        vals = evaluate(self._tuple)
+    def evaluate(self, donate: Sequence[Any] = ()):  # type: ignore[override]
+        vals = evaluate(self._tuple, donate=donate)
         return dict(zip(self._keys, vals))
 
-    def force(self):  # type: ignore[override]
-        return self.evaluate()
+    def force(self, donate: Sequence[Any] = ()):  # type: ignore[override]
+        return self.evaluate(donate=donate)
 
     def glom(self):  # type: ignore[override]
         return {k: v.glom() for k, v in self.evaluate().items()}
@@ -604,7 +616,66 @@ class _SigCtx:
         return sig
 
 
-_compile_cache: Dict[Tuple, Callable] = {}
+class _PlanSigCtx(_SigCtx):
+    """Signs the RAW (pre-optimizer) DAG for the plan cache.
+
+    Nodes carrying a cached ``_result`` sign as Val leaves — exactly
+    the rewrite ``CollapseCachedPass`` would perform — because the
+    optimizer's output is state-dependent: the same structure with a
+    different cached-result frontier optimizes to a different plan.
+    ``_forced_tiling`` markers stay in the signature via the base
+    class. One traversal produces both the plan key and the raw leaf
+    list the cached plan's arguments are gathered from."""
+
+    def of(self, node: Expr) -> Tuple:
+        if node._id in self._memo:
+            return ("ref", self._visit[node._id])
+        if (node._result is not None and not isinstance(node, ValExpr)
+                and isinstance(node._result, DistArray)):
+            # matches ValExpr._sig for the leaf CollapseCachedPass
+            # would substitute (no forced marker: the substituted
+            # ValExpr never carries one)
+            sig = ("val", self.leaf_pos(node), node._shape,
+                   str(node._dtype), node._result.tiling.axes)
+            self._visit[node._id] = len(self._memo)
+            self._memo[node._id] = sig
+            return sig
+        return super().of(node)
+
+
+class _Plan:
+    """Complete steady-state execution recipe for one raw-DAG
+    signature: the compile-cache key, the traced callable (donation
+    variants re-jit it with ``donate_argnums``), output tilings, and
+    ``arg_order`` mapping each executable argument position to the
+    position of the raw leaf that feeds it."""
+
+    __slots__ = ("key", "traced", "out_tilings", "is_tuple", "arg_order")
+
+    def __init__(self, key: Tuple, traced: Callable,
+                 out_tilings: Tuple[Tiling, ...], is_tuple: bool,
+                 arg_order: Tuple[int, ...]):
+        self.key = key
+        self.traced = traced
+        self.out_tilings = out_tilings
+        self.is_tuple = is_tuple
+        self.arg_order = arg_order
+
+
+class _Exec:
+    """A jitted executable plus whether its first (trace + XLA
+    compile) call already happened — for compile/dispatch phase
+    attribution."""
+
+    __slots__ = ("jitted", "warm")
+
+    def __init__(self, jitted: Callable):
+        self.jitted = jitted
+        self.warm = False
+
+
+_compile_cache: Dict[Tuple, _Exec] = {}
+_plan_cache: Dict[Tuple, _Plan] = {}
 _cache_lock = threading.Lock()
 
 
@@ -612,9 +683,21 @@ def compile_cache_size() -> int:
     return len(_compile_cache)
 
 
+def plan_cache_size() -> int:
+    return len(_plan_cache)
+
+
 def clear_compile_cache() -> None:
+    # the plan cache holds references into the compile cache (its key
+    # and traced closure), so the two clear together
     with _cache_lock:
         _compile_cache.clear()
+        _plan_cache.clear()
+
+
+def clear_plan_cache() -> None:
+    with _cache_lock:
+        _plan_cache.clear()
 
 
 def _leaf_arg(leaf: Expr) -> Any:
@@ -622,25 +705,223 @@ def _leaf_arg(leaf: Expr) -> Any:
         return leaf.value.jax_array
     if isinstance(leaf, ScalarExpr):
         return leaf.pyvalue
+    if isinstance(leaf._result, DistArray):
+        return leaf._result.jax_array  # cached node signed as a Val leaf
     raise TypeError(f"unknown leaf {leaf!r}")
 
 
-def evaluate(expr: Expr) -> DistArray:
-    """Evaluate one root: optimize -> signature -> (cached) jit -> run."""
+def _leaf_array(leaf: Expr) -> Optional[DistArray]:
+    """The DistArray behind a leaf (None for scalars)."""
+    if isinstance(leaf, ValExpr):
+        return leaf.value
+    if isinstance(leaf, ScalarExpr):
+        return None
+    return leaf._result if isinstance(leaf._result, DistArray) else None
+
+
+def _norm_donate(donate: Sequence[Any]) -> List[DistArray]:
+    out: List[DistArray] = []
+    for d in donate:
+        if isinstance(d, DistArray):
+            out.append(d)
+        elif isinstance(d, ValExpr):
+            out.append(d.value)
+        elif isinstance(d, Expr) and isinstance(d._result, DistArray):
+            out.append(d._result)
+        else:
+            raise TypeError(
+                f"donate expects DistArrays (or evaluated exprs), got "
+                f"{type(d).__name__}")
+    return out
+
+
+def _opt_flags_key() -> Tuple:
+    """Everything the optimizer stack reads that the raw signature
+    cannot see: a plan is only reusable under the exact pass
+    configuration that produced it."""
+    from .optimize import _PASSES, _ensure_tiling_pass
+
+    # late-registered passes (smart tiling self-registers on first
+    # optimize) must be in the registry BEFORE the key is read, or the
+    # very first plan key in a process can never be hit again
+    _ensure_tiling_pass()
+    return (tuple(p.name for p in _PASSES if p.enabled()),
+            FLAGS.opt_fold_slices, FLAGS.placement,
+            FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
+            FLAGS.tiling_operand_move_weight)
+
+
+def _arg_order(raw_leaves: List[Expr],
+               opt_leaves: List[Expr]) -> Optional[Tuple[int, ...]]:
+    """Map each optimized-DAG leaf back to the raw-DAG leaf feeding it.
+
+    The passes either keep leaf objects intact (fusion re-plumbs, never
+    re-creates, Val/Scalar leaves) or substitute ``ValExpr(n._result)``
+    for a cached node — which the raw traversal already signed as a
+    leaf — so identity on the Expr or on its DistArray recovers the raw
+    position. Returns None (plan not cacheable) if a pass ever
+    introduces a leaf with no raw counterpart."""
+    pos: Dict[int, int] = {}
+    for i, leaf in enumerate(raw_leaves):
+        pos.setdefault(id(leaf), i)
+        arr = _leaf_array(leaf)
+        if arr is not None:
+            pos.setdefault(id(arr), i)
+    order = []
+    for leaf in opt_leaves:
+        j = pos.get(id(leaf))
+        if j is None and isinstance(leaf, ValExpr):
+            j = pos.get(id(leaf.value))
+        if j is None:
+            return None
+        order.append(j)
+    return tuple(order)
+
+
+def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
+              order: Tuple[int, ...], donated: List[DistArray],
+              mesh) -> Any:
+    """Run a plan: gather leaf args, (lazily) fetch the right donation
+    variant of the executable, execute, wrap, invalidate donated
+    buffers, seed the root's result cache."""
+    t0 = time.perf_counter()
+    ordered = [leaves[i] for i in order]
+    args = [_leaf_arg(leaf) for leaf in ordered]
+
+    darrs: List[DistArray] = []
+    dpos: List[int] = []
+    seen: Dict[int, int] = {}
+    for j, leaf in enumerate(ordered):
+        arr = _leaf_array(leaf)
+        if arr is None:
+            continue
+        if arr._donate_next or any(arr is d for d in donated):
+            if id(arr) in seen:
+                # the same buffer feeds two argument slots: aliasing it
+                # into the output is unsafe, so don't donate either
+                # position (the wrapper is still invalidated below)
+                k = seen[id(arr)]
+                if k in dpos:
+                    dpos.remove(k)
+                continue
+            seen[id(arr)] = j
+            dpos.append(j)
+            if not any(arr is d for d in darrs):
+                darrs.append(arr)
+    donate_key = frozenset(dpos)
+    prof.record_phase("build", time.perf_counter() - t0)
+
+    with _cache_lock:
+        ex = _compile_cache.get(plan.key + (donate_key,))
+    if ex is None:
+        mine = _Exec(jax.jit(plan.traced,
+                             donate_argnums=tuple(sorted(dpos)))
+                     if dpos else jax.jit(plan.traced))
+        with _cache_lock:
+            ex = _compile_cache.setdefault(plan.key + (donate_key,), mine)
+        if ex is mine:
+            prof.count("compiles")
+            log_debug("compiled expr dag sig=%s donate=%s",
+                      hash(plan.key), sorted(dpos))
+
+    def run() -> Any:
+        with warnings.catch_warnings():
+            if dpos:
+                # backends without aliasing support (XLA:CPU) warn per
+                # dispatch; donation there is bookkeeping-only
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+            if FLAGS.profile:
+                with jax.profiler.trace(FLAGS.profile_dir):
+                    o = ex.jitted(*args)
+                    jax.block_until_ready(o)
+                return o
+            return ex.jitted(*args)
+
+    fresh = not ex.warm
+    t0 = time.perf_counter()
+    out = run()
+    prof.record_phase("compile" if fresh else "dispatch",
+                      time.perf_counter() - t0)
+    ex.warm = True
+
+    if FLAGS.check_determinism and not dpos:  # a donated arg is gone
+        out2 = run()
+        pairs = zip(out, out2) if plan.is_tuple else [(out, out2)]
+        for o1, o2 in pairs:
+            if not bool(jnp.all(o1 == o2)):
+                raise AssertionError("nondeterministic evaluation detected")
+
+    t0 = time.perf_counter()
+    if plan.is_tuple:
+        result: Any = tuple(DistArray(o, t, mesh)
+                            for o, t in zip(out, plan.out_tilings))
+    else:
+        result = DistArray(out, plan.out_tilings[0], mesh)
+    for arr in darrs:
+        arr._release_donated()
+    if darrs:
+        prof.count("donated_dispatches")
+    expr._result = result
+    prof.record_phase("build", time.perf_counter() - t0)
+    return result
+
+
+def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
+    """Evaluate one root.
+
+    Steady state (plan-cache hit): ONE raw-DAG traversal -> arg gather
+    -> dispatch — no optimizer rewrites, no cost model, no re-signing.
+    Miss: optimize -> signature -> (cached) jit -> run, then the
+    complete plan (leaf order, out tilings, compiled executable) is
+    memoized under the raw structural signature so the next
+    structurally-identical evaluate skips the planner entirely.
+
+    ``donate``: DistArrays (or their evaluated exprs) whose buffers the
+    caller releases to this evaluation. The executable is compiled as a
+    ``donate_argnums`` variant so XLA may reuse their HBM for the
+    outputs, and the donated DistArrays are invalidated — any later use
+    raises instead of reading freed memory. ``DistArray.donate()``
+    marks an array for the same treatment without threading an
+    argument."""
     if expr._result is not None:
         return expr._result
 
+    prof.count("evaluations")
+    mesh = mesh_mod.get_mesh()
+    donated = _norm_donate(donate)
+
+    rctx: Optional[_PlanSigCtx] = None
+    plan_key: Optional[Tuple] = None
+    if FLAGS.plan_cache:
+        t0 = time.perf_counter()
+        rctx = _PlanSigCtx()
+        raw_sig = rctx.of(expr)
+        plan_key = (raw_sig, _opt_flags_key(),
+                    tuple(sorted(mesh.shape.items())))
+        prof.record_phase("sign", time.perf_counter() - t0)
+        with _cache_lock:
+            plan = _plan_cache.get(plan_key)
+        if plan is not None:
+            prof.count("plan_hits")
+            return _dispatch(expr, plan, rctx.leaves, plan.arg_order,
+                             donated, mesh)
+        prof.count("plan_misses")
+
     from .optimize import optimize
 
+    t0 = time.perf_counter()
     dag = optimize(expr)
+    prof.record_phase("optimize", time.perf_counter() - t0)
     if dag._result is not None:
         expr._result = dag._result
         return dag._result
 
+    t0 = time.perf_counter()
     ctx = _SigCtx()
     root_sig = ctx.of(dag)
+    prof.record_phase("sign", time.perf_counter() - t0)
     leaves = ctx.leaves
-    mesh = mesh_mod.get_mesh()
     is_tuple = isinstance(dag, TupleExpr)
     if is_tuple:
         out_tilings = dag.out_tilings()
@@ -650,53 +931,35 @@ def evaluate(expr: Expr) -> DistArray:
     key = (root_sig, tuple(t.axes for t in out_tilings),
            tuple(sorted(mesh.shape.items())))
 
-    with _cache_lock:
-        jitted = _compile_cache.get(key)
-    if jitted is None:
-        leaf_ids = tuple(l._id for l in leaves)
-        out_shardings = tuple(t.sharding(mesh) for t in out_tilings)
+    leaf_ids = tuple(l._id for l in leaves)
+    out_shardings = tuple(t.sharding(mesh) for t in out_tilings)
 
-        def traced(*args: Any) -> Any:
-            env: Dict[int, Any] = dict(zip(leaf_ids, args))
-            out = dag.lower(env)
-            # a constraint (not jit out_shardings) so GSPMD propagation can
-            # negotiate ops like reverse that hard-fail on output overrides
-            if is_tuple:
-                return tuple(
-                    jax.lax.with_sharding_constraint(o, s)
-                    for o, s in zip(out, out_shardings))
-            return jax.lax.with_sharding_constraint(out, out_shardings[0])
+    def traced(*args: Any) -> Any:
+        env: Dict[int, Any] = dict(zip(leaf_ids, args))
+        out = dag.lower(env)
+        # a constraint (not jit out_shardings) so GSPMD propagation can
+        # negotiate ops like reverse that hard-fail on output overrides
+        if is_tuple:
+            return tuple(
+                jax.lax.with_sharding_constraint(o, s)
+                for o, s in zip(out, out_shardings))
+        return jax.lax.with_sharding_constraint(out, out_shardings[0])
 
-        jitted = jax.jit(traced)
-        with _cache_lock:
-            _compile_cache[key] = jitted
-        log_debug("compiled expr dag sig=%s", hash(key))
-    else:
-        # cached executable closes over ITS dag's leaf ids; reseed by
-        # position, which the signature guarantees to match
-        pass
+    identity = tuple(range(len(leaves)))
+    plan = _Plan(key, traced, out_tilings, is_tuple, identity)
 
-    args = [_leaf_arg(l) for l in leaves]
-    if FLAGS.profile:
-        with jax.profiler.trace(FLAGS.profile_dir):
-            out = jitted(*args)
-            jax.block_until_ready(out)
-    else:
-        out = jitted(*args)
-    if is_tuple:
-        result: Any = tuple(DistArray(o, t, mesh)
-                            for o, t in zip(out, out_tilings))
-    else:
-        result = DistArray(out, out_tilings[0], mesh)
+    if rctx is not None and plan_key is not None:
+        raw_order = _arg_order(rctx.leaves, leaves)
+        if raw_order is not None:
+            stored = _Plan(key, traced, out_tilings, is_tuple, raw_order)
+            with _cache_lock:
+                _plan_cache.setdefault(plan_key, stored)
+        else:
+            prof.count("plan_uncacheable")
 
-    if FLAGS.check_determinism:
-        out2 = jitted(*args)
-        pairs = zip(out, out2) if is_tuple else [(out, out2)]
-        for o1, o2 in pairs:
-            if not bool(jnp.all(o1 == o2)):
-                raise AssertionError("nondeterministic evaluation detected")
-
-    expr._result = result
+    # this first run dispatches through the same path a hit takes, with
+    # identity arg order over the OPTIMIZED leaves
+    result = _dispatch(expr, plan, leaves, identity, donated, mesh)
     dag._result = result
     return result
 

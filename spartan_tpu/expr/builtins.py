@@ -343,15 +343,18 @@ class SampleSortExpr(Expr):
 
     def _default_tiling(self):
         from ..array import tiling as tiling_mod
+        from ..ops import sort as sort_ops
 
         if self.ndim <= 1:
             return tiling_mod.row(1)
         # batch axes keep the operand's shardings; the sort axis comes
-        # back sharded where the kernel ran it (see ops/sort.py _run)
+        # back sharded where the kernel ran it. Axis selection and
+        # batch clearing are the SAME helpers _run uses, so this
+        # declared tiling cannot diverge from the kernel's out_specs
+        # (ADVICE round 5, finding 1).
         moved = self._moved_in_tiling()
-        name = moved.axes[-1] if isinstance(moved.axes[-1], str) \
-            else tiling_mod.AXIS_ROW
-        axes = [None if a == name else a for a in moved.axes[:-1]]
+        name = sort_ops.collective_axis(moved)
+        axes = list(sort_ops.batch_axes(moved, name, self.ndim))
         axes.insert(self.axis, name)
         return tiling_mod.Tiling(axes)
 
@@ -649,7 +652,17 @@ def histogram(x, bins: int = 10, range=None):
     (np.histogram semantics); a degenerate range or constant data
     expands value +/- 0.5 like numpy. Edges are f32 (no x64 on
     device) and are computed by the same formula the bucketing kernel
-    uses, so exact-edge values land where the returned edges say."""
+    uses, so exact-edge values land where the returned edges say.
+
+    Divergence from np.histogram (ADVICE round 5, finding 2): with
+    ``range=None`` the (min, max) autodetection runs ON DEVICE inside
+    the same traced program — there is no host round trip at which a
+    non-finite range could raise. Data containing NaN/±inf therefore
+    yields non-finite edges (NaN propagates through the min/max
+    reductions) and meaningless counts, where ``np.histogram`` raises
+    ``ValueError("autodetected range of [nan, nan] is not finite")``.
+    Pass an explicit finite ``range`` for data that may contain
+    non-finite values."""
     from .map2 import map2
 
     x = as_expr(x)
@@ -745,10 +758,16 @@ def unique(x, size: int, fill_value=0.0, return_counts: bool = False):
             return vals
         return vals, zeros((size,), np.int32)
     s = sort(x)
+    # boundary flags via roll + where, NOT concatenate([ones(1), ...]):
+    # the uneven-concat halo pattern mis-partitions under GSPMD on some
+    # jax/XLA:CPU versions (every boundary double-counted — same bug
+    # family as the linspace lowering note in ndarray.py); roll lowers
+    # to a collective-permute that partitions exactly. Slot 0's rolled
+    # neighbor is the LAST element, masked off by the where.
     flags = map_expr(
-        lambda v: jnp.concatenate(
-            [jnp.ones((1,), jnp.int32),
-             (v[1:] != v[:-1]).astype(jnp.int32)]), s)
+        lambda v: jnp.where(
+            jnp.arange(v.shape[0]) == 0, 1,
+            (v != jnp.roll(v, 1)).astype(jnp.int32)).astype(jnp.int32), s)
     rank = cumsum(flags) - 1
     vals = map2(
         [s, rank, flags],

@@ -25,7 +25,7 @@ import numpy as np
 
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
-from .base import Expr, as_expr
+from .base import Expr, ValExpr, as_expr
 
 
 class CarryExpr(Expr):
@@ -163,22 +163,25 @@ class LoopItemExpr(Expr):
         b = loop.body_roots[idx]
         super().__init__(b.shape, b.dtype)
 
-    def evaluate(self):
+    def evaluate(self, donate=()):
         if self._result is not None:
             return self._result
+        # loop-carry donation: with donate_init the init buffers feed
+        # only this loop and die with it — release them to the dispatch
+        donate = tuple(donate) + getattr(self.loop, "_donate_init", ())
         siblings = getattr(self.loop, "_items", None)
         # identity check, NOT `in`: Expr.__eq__ builds comparison exprs
         if (siblings and len(siblings) > 1
                 and any(s is self for s in siblings)):
             from .base import TupleExpr, evaluate as eval_root
 
-            results = eval_root(TupleExpr(siblings))
+            results = eval_root(TupleExpr(siblings), donate=donate)
             for item, res in zip(siblings, results):
                 item._result = res
             return self._result
         from .base import evaluate as eval_root
 
-        return eval_root(self)
+        return eval_root(self, donate=donate)
 
     force = evaluate
 
@@ -200,7 +203,7 @@ class LoopItemExpr(Expr):
 
 
 def loop(n_iters: Any, body_fn: Callable, *init: Any,
-         with_index: bool = False):
+         with_index: bool = False, donate_init: bool = False):
     """Iterate ``body_fn`` ``n_iters`` times entirely on device.
 
     ``body_fn`` receives one lazy expr per carried value (prepended with
@@ -213,6 +216,12 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
     The whole loop is one XLA program: no per-iteration dispatch, no
     per-iteration fetch (contrast SURVEY.md §3.4's per-iteration
     driver<->worker round trips in the reference).
+
+    ``donate_init``: release the init DistArrays' buffers to the loop
+    dispatch (``evaluate(donate=...)`` — the carry re-feed overwrites
+    them anyway, so XLA may alias their HBM for the outputs). The
+    donated init arrays are invalidated when the loop is forced;
+    re-using them afterwards raises.
     """
     init_exprs = tuple(as_expr(i) for i in init)
     if not init_exprs:
@@ -247,4 +256,7 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
                   index_expr)
     items = tuple(LoopItemExpr(le, i) for i in range(len(init_exprs)))
     le._items = items  # sibling set for one-program multi-carry forcing
+    if donate_init:
+        le._donate_init = tuple(
+            i.value for i in init_exprs if isinstance(i, ValExpr))
     return items[0] if len(items) == 1 else items

@@ -55,8 +55,18 @@ class CreateExpr(Expr):
             return jnp.eye(n, m, k_off, dtype=self.dtype)
         if k == "linspace":
             start, stop, num, endpoint = self.params
-            return jnp.linspace(start, stop, num, endpoint=endpoint,
-                                dtype=self.dtype)
+            # explicit iota lowering: jnp.linspace's internal pattern
+            # mis-partitions under a GSPMD sharding constraint on some
+            # jax/XLA:CPU versions (every value uniformly doubled); a
+            # plain start + step * iota partitions exactly
+            if num == 1:
+                return jnp.full((1,), start, self.dtype)
+            step = (stop - start) / ((num - 1) if endpoint else num)
+            out = (jnp.float32(start)
+                   + jnp.float32(step) * jax.lax.iota(jnp.float32, num))
+            if endpoint:  # pin the last sample exactly, like np.linspace
+                out = out.at[-1].set(jnp.float32(stop))
+            return out.astype(self.dtype)
         raise ValueError(f"unknown creation kind {self.kind!r}")
 
     def _sig(self, ctx) -> Tuple:

@@ -176,10 +176,17 @@ def _ensure_tiling_pass() -> None:
 
 
 def optimize(root: Expr) -> Expr:
+    """Run the enabled pass stack. Only plan-cache MISSES reach this
+    (expr/base.py evaluate): steady-state iterative drivers skip it
+    entirely. Per-pass wall time accumulates under ``pass:<name>`` in
+    utils/profiling for the dispatch-overhead benchmark."""
+    from ..utils import profiling as prof
+
     _ensure_tiling_pass()
     for p in _PASSES:
         if p.enabled():
-            root = p.run(root)
+            with prof.phase("pass:" + p.name):
+                root = p.run(root)
     return root
 
 

@@ -292,30 +292,45 @@ def _shuffle_sharded(src, kernel, kw, out_shape, out_dtype, out_tiling,
     src_extents = list(src.extents())
     if workers is None:
         # scale with the machine and the work, capped: more threads than
-        # source tiles idle, and past ~4x cores they only add contention
-        workers = min(32, 4 * (os.cpu_count() or 1))
+        # source tiles idle, and past ~4x cores they only add contention.
+        # A single-core host gets NO pool at all (workers=1 runs inline
+        # below): the fan-out can't overlap anything there, and
+        # concurrent execute/fetch against the XLA:CPU client has shown
+        # lost-wakeup deadlocks on 1-vCPU VMs (every thread parked in
+        # futex_wait) — serial invocation sidesteps the fragile path.
+        cores = os.cpu_count() or 1
+        workers = min(32, 4 * cores) if cores > 1 else 1
     n_workers = max(1, min(workers, len(src_extents)))
-    # slack over the pool size keeps workers fed at the tile boundary;
-    # growing it 2x with the pool would scale peak buffered piece-copies
-    # with core count, so the prefetch margin stays small and fixed
-    window = n_workers + 4
-    with ThreadPoolExecutor(max_workers=n_workers) as pool:
-        pending = deque()
-        todo = iter(enumerate(src_extents))
-
-        def submit_next():
-            for i, e in todo:
-                pending.append(pool.submit(run_tile, i, e))
-                return
-
-        for _ in range(window):
-            submit_next()
-        while pending:
-            routed = pending.popleft().result()  # source-tile order
-            submit_next()
-            for r_ext, isect, piece in routed:
+    if n_workers == 1:
+        # inline: same semantics (source-tile order, ordered combiner
+        # application), no pool thread
+        for i, e in enumerate(src_extents):
+            for r_ext, isect, piece in run_tile(i, e):
                 apply_update(block_of(r_ext),
                              isect.offset_from(r_ext).to_slice(), piece)
+    else:
+        # slack over the pool size keeps workers fed at the tile
+        # boundary; growing it 2x with the pool would scale peak
+        # buffered piece-copies with core count, so the prefetch margin
+        # stays small and fixed
+        window = n_workers + 4
+        with ThreadPoolExecutor(max_workers=n_workers) as pool:
+            pending = deque()
+            todo = iter(enumerate(src_extents))
+
+            def submit_next():
+                for i, e in todo:
+                    pending.append(pool.submit(run_tile, i, e))
+                    return
+
+            for _ in range(window):
+                submit_next()
+            while pending:
+                routed = pending.popleft().result()  # source-tile order
+                submit_next()
+                for r_ext, isect, piece in routed:
+                    apply_update(block_of(r_ext),
+                                 isect.offset_from(r_ext).to_slice(), piece)
 
     per_device: dict = {}
     placed = set()

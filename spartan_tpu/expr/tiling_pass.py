@@ -12,6 +12,13 @@ out-shardings.
 The full cost model lands with the dot/shuffle layer; this module wires
 the pass into the pipeline so the FLAG ablation surface exists from the
 start.
+
+Cost: building the candidate table + the DP over it is the dominant
+per-force planning expense (~ the whole optimizer stack). It runs only
+on plan-cache MISSES — ``evaluate`` (expr/base.py) keys the complete
+plan, this pass's ``_forced_tiling``/``_dot_plan`` choices included,
+on the raw DAG's structural signature, so iterative drivers re-run the
+cost model once per structure, not once per step.
 """
 
 from __future__ import annotations

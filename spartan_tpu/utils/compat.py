@@ -1,0 +1,51 @@
+"""Version-compat shims for the JAX surface this framework uses.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to a top-level
+``jax.shard_map`` export, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` along the way. Every internal call site
+imports it from here; the wrapper translates whichever spelling the
+pinned jax does not understand (call sites pass ``mesh=``/``in_specs=``/
+``out_specs=`` by keyword, which both generations accept).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # jax with the top-level export
+except ImportError:  # pragma: no cover - depends on the pinned jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+    _PARAMS = frozenset()
+
+
+def shard_map(f, **kwargs):
+    if _PARAMS:
+        if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)
+
+
+def pcast(x, axes, to="varying"):
+    """``lax.pcast`` across jax generations: falls back to ``pvary``
+    (its predecessor), and on jax without either the varying-ness
+    type system doesn't exist — the value itself is unchanged, so
+    identity is the correct lowering."""
+    from jax import lax
+
+    fn = getattr(lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axes, to=to)
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None and to == "varying":
+        return pvary(x, axes)
+    return x
+
+
+__all__ = ["shard_map", "pcast"]

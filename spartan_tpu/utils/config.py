@@ -151,6 +151,12 @@ FLAGS.define_bool("opt_collapse_cached", True,
                   "Collapse already-evaluated sub-DAGs into leaves.")
 FLAGS.define_bool("opt_auto_tiling", True,
                   "Smart-tiling pass: pick shardings via the cost model.")
+FLAGS.define_bool(
+    "plan_cache", True,
+    "Cache the complete evaluation plan (leaf order, out tilings, "
+    "compiled executable) keyed on the RAW DAG's structural signature, "
+    "so steady-state evaluate() skips the optimizer stack and "
+    "re-signing entirely (one traversal + dispatch).")
 FLAGS.define_float(
     "tiling_compute_weight", 0.0,
     "Bytes-priced compute weight for NON-contraction nodes in the "

@@ -11,6 +11,7 @@ device memory stats.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
@@ -19,6 +20,81 @@ import numpy as np
 
 from .config import FLAGS
 from .log import log_info
+
+# -- plan-cache counters and per-phase timers ----------------------------
+#
+# The evaluate() fast path (expr/base.py) is instrumented with named
+# counters (plan_hits / plan_misses / compiles / donated_dispatches /
+# evaluations) and per-phase wall-time accumulators:
+#
+#   sign      structural signing (raw-DAG plan signature + optimized-DAG
+#             compile signature)
+#   optimize  the optimizer pass stack (plus per-pass ``pass:<name>``)
+#   compile   jit wrapper creation + the first call (trace + XLA compile)
+#   dispatch  steady-state execution of an already-compiled program
+#   build     Python-side assembly around dispatch: plan lookup, leaf
+#             arg gathering, DistArray result wrapping
+#
+# Counters are process-global; tests and benchmarks bracket a region
+# with reset_counters() and read counters() after.
+
+_stats_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_phase_seconds: Dict[str, float] = {}
+
+
+def count(name: str, n: int = 1) -> None:
+    with _stats_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def record_phase(name: str, seconds: float) -> None:
+    with _stats_lock:
+        _phase_seconds[name] = _phase_seconds.get(name, 0.0) + seconds
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_phase(name, time.perf_counter() - t0)
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the named counters (plan_hits, plan_misses, ...);
+    absent counters read as 0 via .get()."""
+    with _stats_lock:
+        return dict(_counters)
+
+
+def phase_seconds() -> Dict[str, float]:
+    """Snapshot of accumulated per-phase wall time in seconds."""
+    with _stats_lock:
+        return dict(_phase_seconds)
+
+
+def reset_counters() -> None:
+    with _stats_lock:
+        _counters.clear()
+        _phase_seconds.clear()
+
+
+def plan_cache_stats() -> Dict[str, Any]:
+    """Hit/miss view of the evaluate() plan cache, with the hit rate
+    the acceptance gate asserts (None before any lookup)."""
+    c = counters()
+    hits = c.get("plan_hits", 0)
+    misses = c.get("plan_misses", 0)
+    total = hits + misses
+    return {
+        "plan_hits": hits,
+        "plan_misses": misses,
+        "compiles": c.get("compiles", 0),
+        "donated_dispatches": c.get("donated_dispatches", 0),
+        "hit_rate": (hits / total) if total else None,
+    }
 
 
 @contextlib.contextmanager

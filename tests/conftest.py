@@ -18,6 +18,15 @@ import jax  # noqa: E402
 
 # The box's site config re-forces JAX_PLATFORMS=axon; the config API wins.
 jax.config.update("jax_platforms", "cpu")
+# The XLA:CPU async dispatch thread intermittently deadlocks (futex
+# wait at init/exit/mid-run) when 8 virtual devices share ONE physical
+# core — observed freezing whole suite runs at random points. Tests
+# are correctness checks, not throughput: synchronous dispatch costs a
+# little latency and removes the lottery.
+try:
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+except (AttributeError, ValueError):  # older/newer jax without the knob
+    pass
 import pytest  # noqa: E402
 
 

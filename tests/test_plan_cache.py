@@ -1,0 +1,212 @@
+"""Plan cache + buffer donation (expr/base.py evaluate fast path).
+
+The no-replanning guard is counter-based: utils/profiling counts plan
+hits/misses and jit compiles, so a steady-state iterative driver that
+rebuilds its DAG every step must show exactly one miss and one compile
+across N iterations — any replanning regression trips the exact
+counts, not a timing threshold.
+"""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.examples.kmeans import kmeans_step
+from spartan_tpu.expr import base as expr_base
+from spartan_tpu.expr.base import ValExpr, evaluate
+from spartan_tpu.utils import profiling
+from spartan_tpu.utils.config import FLAGS
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    st.clear_compile_cache()
+    profiling.reset_counters()
+    yield
+    st.clear_compile_cache()
+    profiling.reset_counters()
+
+
+def _kmeans_state(n=64, d=8, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+    # one warmup step so the centers leaf reaches its steady-state
+    # tiling (the step emits replicated centers; the init layout is
+    # whatever from_numpy chose)
+    c = kmeans_step(pts, ValExpr(c), k).evaluate()
+    return pts, c, k
+
+
+def test_no_replanning_20_iters():
+    """20 rebuilt k-means-step DAGs: 1 plan miss, 1 compile, 19 hits —
+    and a 100% hit rate after the first step (the acceptance gate)."""
+    pts, c, k = _kmeans_state()
+    st.clear_compile_cache()
+    profiling.reset_counters()
+    results = []
+    for _ in range(20):
+        c = kmeans_step(pts, ValExpr(c), k).evaluate()
+        results.append(np.asarray(c.glom()))
+    counts = profiling.counters()
+    assert counts["plan_misses"] == 1
+    assert counts["compiles"] == 1
+    assert counts["plan_hits"] == 19
+    stats = profiling.plan_cache_stats()
+    assert stats["plan_hits"] / (stats["plan_hits"]
+                                 + stats["plan_misses"]) == 19 / 20
+    assert expr_base.plan_cache_size() == 1
+
+    # plan-hit dispatches compute real results: the whole 20-step
+    # trajectory matches a pure NumPy oracle
+    p = np.asarray(pts.glom())
+    cc = np.asarray(results[0])  # oracle re-runs steps 2..20
+    for _ in range(19):
+        d2 = ((p[:, None, :] - cc[None, :, :]) ** 2).sum(-1)
+        a = d2.argmin(1)
+        sums = np.zeros_like(cc)
+        cnt = np.zeros(k, np.float32)
+        np.add.at(sums, a, p)
+        np.add.at(cnt, a, 1)
+        cc = sums / np.maximum(cnt, 1.0)[:, None]
+    np.testing.assert_allclose(results[-1], cc, rtol=1e-4, atol=1e-5)
+
+
+def test_plan_hit_matches_miss_numerically():
+    """A plan-hit dispatch must produce bit-identical results to the
+    miss path's first dispatch for the same inputs."""
+    rng = np.random.RandomState(3)
+    xn = rng.rand(16, 16).astype(np.float32)
+    yn = rng.rand(16, 16).astype(np.float32)
+    x, y = st.from_numpy(xn), st.from_numpy(yn)
+
+    def build():
+        return ((st.as_expr(x) + st.as_expr(y)) * 2.0).sum()
+
+    first = float(build().glom())   # miss: full optimize + compile
+    second = float(build().glom())  # hit: raw traversal + dispatch
+    assert first == second
+    c = profiling.counters()
+    assert c["plan_hits"] >= 1 and c["plan_misses"] == 1
+
+
+def test_scalar_change_still_hits():
+    """Python scalars are weak-typed traced args: a different constant
+    is the same plan AND the same executable."""
+    x = st.from_numpy(np.ones((8, 8), np.float32))
+    (st.as_expr(x) * 2.0).evaluate()
+    profiling.reset_counters()
+    out = (st.as_expr(x) * 3.0).evaluate()
+    c = profiling.counters()
+    assert c.get("plan_hits", 0) == 1 and c.get("plan_misses", 0) == 0
+    np.testing.assert_allclose(np.asarray(out.glom()), 3.0)
+
+
+def test_flag_toggle_is_a_different_plan():
+    """Optimizer flags are part of the plan key: toggling a pass must
+    not reuse a plan produced under the old configuration."""
+    x = st.from_numpy(np.ones((8, 8), np.float32))
+    e = (st.as_expr(x) + 1.0) * 2.0
+    e.evaluate()
+    profiling.reset_counters()
+    old = FLAGS.opt_map_fusion
+    try:
+        FLAGS.opt_map_fusion = not old
+        out = ((st.as_expr(x) + 1.0) * 2.0).evaluate()
+    finally:
+        FLAGS.opt_map_fusion = old
+    c = profiling.counters()
+    assert c.get("plan_misses", 0) == 1
+    np.testing.assert_allclose(np.asarray(out.glom()), 4.0)
+
+
+def test_plan_cache_off_still_correct():
+    """FLAGS.plan_cache=False restores the legacy path bit-for-bit."""
+    x = st.from_numpy(np.arange(64, dtype=np.float32).reshape(8, 8))
+    try:
+        FLAGS.plan_cache = False
+        out1 = float((st.as_expr(x) * 2.0).sum().glom())
+        out2 = float((st.as_expr(x) * 2.0).sum().glom())
+    finally:
+        FLAGS.plan_cache = True
+    assert out1 == out2
+    c = profiling.counters()
+    assert c.get("plan_hits", 0) == 0 and c.get("plan_misses", 0) == 0
+
+
+def test_cached_subdag_frontier_is_in_the_key():
+    """The same structure with a different cached-result frontier must
+    not alias: nodes carrying a ``_result`` sign as Val leaves."""
+    x = st.from_numpy(np.full((8, 8), 2.0, np.float32))
+    inner = st.as_expr(x) + 1.0
+    root = inner * 2.0
+    out_cold = np.asarray(root.glom())          # nothing cached
+    inner2 = st.as_expr(x) + 1.0
+    inner2.evaluate()                           # cache the sub-DAG
+    root2 = inner2 * 2.0
+    out_warm = np.asarray(root2.glom())         # frontier differs
+    np.testing.assert_array_equal(out_cold, out_warm)
+
+
+def test_donation_invalidates_and_reuse_raises():
+    """evaluate(donate=[x]): the result is correct, the donated
+    DistArray is invalidated, and ANY reuse raises instead of reading
+    freed HBM."""
+    rng = np.random.RandomState(7)
+    xn = rng.rand(8, 8).astype(np.float32)
+    x = st.from_numpy(xn).evaluate()  # a plain DistArray
+    out = evaluate(st.as_expr(x) + 1.0, donate=[x])
+    np.testing.assert_allclose(np.asarray(out.glom()), xn + 1.0, rtol=1e-6)
+    assert x.is_donated
+    with pytest.raises(RuntimeError, match="donat"):
+        x.glom()
+    with pytest.raises(RuntimeError, match="donat"):
+        (st.as_expr(x) * 2.0).glom()
+    assert profiling.counters().get("donated_dispatches", 0) == 1
+
+
+def test_donate_method_marks_next_evaluate():
+    """x.donate() releases the buffer to the next evaluate consuming
+    it, without threading an argument (loop-carry re-feed shape)."""
+    rng = np.random.RandomState(8)
+    cn = rng.rand(4, 8).astype(np.float32)
+    pts = st.from_numpy(rng.rand(64, 8).astype(np.float32))
+    c = st.as_expr(cn).evaluate()
+    c2 = kmeans_step(pts, ValExpr(c.donate()), 4).evaluate()
+    assert c.is_donated
+    with pytest.raises(RuntimeError, match="donat"):
+        c.glom()
+    assert np.isfinite(np.asarray(c2.glom())).all()
+
+
+def test_donation_zero_change_for_non_donors():
+    """A donating dispatch must not disturb later non-donating callers
+    of the same plan (separate executable variants)."""
+    rng = np.random.RandomState(9)
+    xn = rng.rand(8, 8).astype(np.float32)
+
+    def run(donating):
+        x = st.from_numpy(xn).evaluate()
+        e = st.as_expr(x) * 3.0
+        out = evaluate(e, donate=[x] if donating else ())
+        return np.asarray(out.glom())
+
+    base = run(False)
+    np.testing.assert_array_equal(run(True), base)
+    np.testing.assert_array_equal(run(False), base)  # variant kept apart
+
+
+def test_loop_donate_init():
+    """st.loop(..., donate_init=True): the init buffers die with the
+    loop dispatch and are invalidated afterwards."""
+    w0 = st.from_numpy(np.ones((8,), np.float32)).evaluate()
+    out = st.loop(5, lambda w: w + 1.0, w0, donate_init=True)
+    np.testing.assert_allclose(np.asarray(out.glom()), np.full(8, 6.0))
+    assert w0.is_donated
+    with pytest.raises(RuntimeError, match="donat"):
+        w0.glom()

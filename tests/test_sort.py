@@ -202,7 +202,10 @@ sys.path.insert(0, os.environ["REPO"])
 import jax, numpy as np
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 dev = jax.devices()[0]
 if dev.platform != "tpu":
     print("NOT_TPU", dev.platform); sys.exit(0)
@@ -504,3 +507,53 @@ def test_topk_distributed(mesh1d):
         np.testing.assert_array_equal(gv, ref)
     with pytest.raises(ValueError, match="1 <= k"):
         st.topk(fc, 0)
+
+
+def test_topk_sentinel_extreme_ragged(mesh1d):
+    """Data containing the padding sentinel itself (-inf for
+    largest=True, INT_MIN) on a RAGGED last shard: padding slots carry
+    the same key as real elements, and correctness rests on lax.top_k's
+    lower-index tie-break plus padding living at the global tail (see
+    the invariant comment in ops/sort.py distributed_topk). Every
+    returned index must be a real (< n) position — a broken invariant
+    would surface as an out-of-range index silently clamped by the
+    value gather in builtins.topk."""
+    n = 13  # p=8 -> m=2, 3 padding slots spanning the tail shards
+    a = np.full(n, -np.inf, np.float32)
+    a[3] = 1.0  # one finite element among the sentinels
+    fa = st.from_numpy(a)  # ragged: default (replicated) layout
+    vals, idx = st.topk(fa, 2, largest=True)
+    gv, gi = np.asarray(vals.glom()), np.asarray(idx.glom())
+    assert gi.min() >= 0 and gi.max() < n, f"padding index leaked: {gi}"
+    assert len(set(gi.tolist())) == 2
+    np.testing.assert_array_equal(gv, np.array([1.0, -np.inf], np.float32))
+    np.testing.assert_array_equal(a[gi], gv)
+
+    # all-sentinel data: every winner ties with every padding slot
+    b = np.full(n, -np.inf, np.float32)
+    fb = st.from_numpy(b)
+    vals, idx = st.topk(fb, 2, largest=True)
+    gi = np.asarray(idx.glom())
+    assert gi.min() >= 0 and gi.max() < n, f"padding index leaked: {gi}"
+    assert len(set(gi.tolist())) == 2
+    assert np.all(np.isneginf(np.asarray(vals.glom())))
+
+    # int dtype: INT_MIN is the largest=True sentinel
+    imin = np.iinfo(np.int32).min
+    c = np.full(n, imin, np.int32)
+    c[7] = 5
+    fc = st.from_numpy(c)
+    vals, idx = st.topk(fc, 2, largest=True)
+    gv, gi = np.asarray(vals.glom()), np.asarray(idx.glom())
+    assert gi.min() >= 0 and gi.max() < n, f"padding index leaked: {gi}"
+    np.testing.assert_array_equal(gv, np.array([5, imin], np.int32))
+    np.testing.assert_array_equal(c[gi], gv)
+
+    # smallest-k: +inf / INT_MAX are the sentinels there
+    d = np.full(n, np.inf, np.float32)
+    d[11] = -2.0  # on the ragged tail shard, next to padding
+    fd = st.from_numpy(d)
+    vals, idx = st.topk(fd, 2, largest=False)
+    gv, gi = np.asarray(vals.glom()), np.asarray(idx.glom())
+    assert gi.min() >= 0 and gi.max() < n, f"padding index leaked: {gi}"
+    np.testing.assert_array_equal(gv, np.array([-2.0, np.inf], np.float32))
