@@ -1,0 +1,108 @@
+"""Cost of the graph sanitizer (ISSUE 2 acceptance gate): ``st.check``
+on the k-means-step DAG must cost <10% of a COLD ``evaluate()`` (the
+only place the miss-path wiring can run it), and ~0 on plan-cache hits
+(verification is wired into the MISS path only, so a steady-state
+iterative driver pays nothing).
+
+Measures three quantities on the same rebuilt-every-step k-means DAG
+the dispatch_overhead benchmark uses:
+
+* ``check_us`` — one ``st.check`` (verifier + lints) over the raw DAG;
+* ``cold_evaluate_us`` — a cold-start ``evaluate()``: optimizer stack +
+  signing + jit trace + XLA compile (caches cleared);
+* ``hit_us_verify_{on,off}`` — steady-state per-iteration wall time
+  with ``FLAGS.verify_evaluate`` on vs off: both hit the plan cache,
+  so the ratio is the hit-path toll of the flag (expected ~1.0).
+
+Prints ONE JSON line; ``check_vs_cold_ratio`` <= 0.10 is the committed
+regression floor (benchmarks/thresholds.json, graded by run_all.py).
+
+Usage: python benchmarks/verify_overhead.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median(fn, iters):
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def measure(iters: int = 20, n: int = 4096, d: int = 32,
+            k: int = 16) -> dict:
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr.base import ValExpr
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c0 = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+
+    # -- st.check on the raw step DAG (rebuilt per rep, like a driver)
+    def run_check():
+        st.check(kmeans_step(pts, ValExpr(c0), k))
+
+    run_check()  # warm python imports / eval_shape caches
+    check_s = _median(run_check, iters)
+
+    # -- cold evaluate: the full miss path incl. XLA compile
+    st.clear_compile_cache()
+    t0 = time.perf_counter()
+    c = kmeans_step(pts, ValExpr(c0), k).evaluate()
+    c.glom()
+    cold_s = time.perf_counter() - t0
+
+    # -- steady-state hit path, verify flag on vs off
+    def run_iters(verify_on: bool, c):
+        FLAGS.verify_evaluate = verify_on
+        try:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                c = kmeans_step(pts, ValExpr(c), k).evaluate()
+            c.glom()
+            return (time.perf_counter() - t0) / iters, c
+        finally:
+            FLAGS.verify_evaluate = False
+
+    c = kmeans_step(pts, ValExpr(c), k).evaluate()  # settle the plan
+    hit_off_s, c = run_iters(False, c)
+    hit_on_s, c = run_iters(True, c)
+
+    return {
+        "metric": "verify_overhead",
+        "iters": iters,
+        "shape": [n, d, k],
+        "check_us": round(check_s * 1e6, 1),
+        "cold_evaluate_us": round(cold_s * 1e6, 1),
+        "check_vs_cold_ratio": round(check_s / cold_s, 4),
+        "hit_us_verify_off": round(hit_off_s * 1e6, 1),
+        "hit_us_verify_on": round(hit_on_s * 1e6, 1),
+        "hit_overhead_ratio": round(hit_on_s / hit_off_s, 3)
+        if hit_off_s > 0 else None,
+    }
+
+
+def main() -> None:
+    iters = 20
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    print(json.dumps(measure(iters=iters, n=512 if small else 4096)))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from .parallel import mesh as _mesh
 from .parallel.mesh import (build_mesh, get_mesh, initialize_distributed,
                             set_mesh, status, use_mesh)
 from .ops.stencil import avgpool, maxpool, stencil
+from .analysis import check, lint
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -38,7 +39,8 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "Tiling", "FLAGS",
             "build_mesh", "get_mesh", "set_mesh", "use_mesh", "initialize",
             "initialize_distributed", "shutdown", "status", "collectives",
-            "checkpoint", "profiling", "stencil", "maxpool", "avgpool"]
+            "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
+            "check", "lint"]
            + list(_expr_all))
 
 

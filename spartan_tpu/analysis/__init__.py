@@ -1,0 +1,64 @@
+"""Static analysis over the lazy expression DAG — the checking layer
+for the optimizer pipeline (ISSUE 2: graph sanitizer).
+
+Three coordinated tools, none of which compile or execute anything:
+
+* :mod:`verify` — the DAG well-formedness verifier. One traversal
+  re-derives every node's shape/dtype from its children (via the
+  node's own ``replace_children`` constructor, which IS the shape
+  rule) and validates structure: acyclicity, child types, broadcast
+  legality, axis bounds, ``_sig`` coverage.
+* :mod:`passes` — optimizer-pass invariant checking. When
+  ``FLAGS.verify_passes`` is on (``SPARTAN_VERIFY_PASSES=1``; the
+  test suite turns it on by default), ``optimize()`` snapshots the
+  DAG around every registered ``Pass`` and asserts shape/dtype/leaf
+  preservation plus well-formedness, naming the offending pass.
+* :mod:`lints` — plan-time lints: use-after-donate and
+  double-donation caught before compile instead of mid-execution,
+  declared-tiling vs sort-kernel ``out_specs`` cross-checks (the
+  ADVICE r5 #1 bug class), and unresolvable/degenerate tiling
+  warnings.
+
+Public surface (re-exported as ``st.check`` / ``st.lint``):
+
+* ``check(expr, donate=())`` — raise :class:`VerificationError` on
+  any violation or error-severity lint; returns the warning-level
+  findings otherwise.
+* ``lint(expr, donate=())`` — return ALL findings without raising.
+"""
+
+from .verify import (VerificationError, Violation, verify_dag, walk)
+from .lints import LintFinding, LintWarning, lint
+from .passes import PassInvariantError
+
+from typing import Any, List, Sequence
+
+__all__ = ["check", "lint", "verify_dag", "walk", "Violation",
+           "LintFinding", "LintWarning", "VerificationError",
+           "PassInvariantError"]
+
+
+def check(expr: Any, donate: Sequence[Any] = ()) -> List[LintFinding]:
+    """Statically verify an expression DAG (no compile, no execute).
+
+    Runs the well-formedness verifier plus the plan-time lints and
+    raises :class:`VerificationError` — annotated with each offending
+    node's user build site — if anything error-severity surfaces.
+    Returns the warning-level findings (possibly empty) otherwise.
+    """
+    from ..expr.base import Expr, as_expr
+
+    root = expr if isinstance(expr, Expr) else as_expr(expr)
+    problems: List[str] = []
+    vios = verify_dag(root)
+    problems.extend(str(v) for v in vios)
+    findings: List[LintFinding] = []
+    if not any(v.kind == "cycle" for v in vios):
+        # lints traverse out_tiling()/children; unsafe over a cyclic DAG
+        findings = lint(root, donate)
+        problems.extend(str(f) for f in findings if f.severity == "error")
+    if problems:
+        raise VerificationError(
+            "expression DAG failed static verification:\n  "
+            + "\n  ".join(problems))
+    return [f for f in findings if f.severity != "error"]

@@ -14,6 +14,7 @@ hard part 5).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, List, Optional, Sequence, Union
 
 import jax
@@ -54,11 +55,27 @@ def _canonical_reducer(reducer: Any) -> str:
                      f"{sorted(k for k in REDUCERS if k)}")
 
 
+def _caller_site():
+    """First stack frame outside spartan_tpu — records WHERE a
+    donation was requested, so use-after-donate errors (and the
+    plan-time lint, analysis/lints.py) name the donating call."""
+    import sys
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(pkg):
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
 class DistArray:
     """A distributed N-d array: ``jax.Array`` + :class:`Tiling` over the
     ambient mesh."""
 
-    __slots__ = ("_jax", "tiling", "mesh", "_donate_next")
+    __slots__ = ("_jax", "tiling", "mesh", "_donate_next", "_donate_site")
 
     def __init__(self, jax_array: jax.Array, tiling: Tiling,
                  mesh: Optional[Mesh] = None):
@@ -67,6 +84,7 @@ class DistArray:
                 f"tiling rank {tiling.ndim} != array rank {jax_array.ndim}")
         self._jax = jax_array
         self._donate_next = False
+        self._donate_site = None
         self.tiling = tiling
         self.mesh = mesh or mesh_mod.get_mesh()
 
@@ -76,11 +94,14 @@ class DistArray:
     def jax_array(self) -> jax.Array:
         arr = self._jax
         if arr is None:
+            site = (f" (donated at {self._donate_site[0]}:"
+                    f"{self._donate_site[1]}, in {self._donate_site[2]})"
+                    if self._donate_site else "")
             raise RuntimeError(
                 "DistArray used after donation: its device buffer was "
                 "released to an evaluate(donate=...) / .donate() "
-                "dispatch; rebuild the array (or keep a copy) instead "
-                "of reusing the donated handle")
+                f"dispatch{site}; rebuild the array (or keep a copy) "
+                "instead of reusing the donated handle")
         return arr
 
     @jax_array.setter
@@ -97,6 +118,8 @@ class DistArray:
         raises cleanly instead of reading freed HBM. Returns ``self``
         for call-site chaining: ``evaluate(step(c.donate()))``."""
         self._donate_next = True
+        if self._donate_site is None:
+            self._donate_site = _caller_site()
         return self
 
     @property
@@ -127,6 +150,8 @@ class DistArray:
         return int(self.jax_array.size)
 
     def __repr__(self) -> str:
+        if self._jax is None:  # donated handle: no metadata left to read
+            return f"DistArray(<donated>, tiling={self.tiling})"
         return (f"DistArray(shape={self.shape}, dtype={self.dtype}, "
                 f"tiling={self.tiling})")
 

@@ -302,12 +302,24 @@ class Expr:
         return id(self)
 
     def __bool__(self) -> bool:
-        # NumPy semantics: only size-1 results truth-test (forces eval).
-        if self.size != 1:
-            raise TypeError(
-                "truth value of a multi-element Expr is ambiguous; "
-                "use .any()/.all()")
-        return bool(self.glom().reshape(()))
+        # Never truth-test an Expr: __eq__/__lt__/... build lazy
+        # element-wise graphs, so `if expr:`, `expr in seq`, and
+        # `assert expr == y` would silently build (or worse, force) a
+        # graph where the caller expected a Python bool. Raise loudly
+        # with both the build site and the remedy.
+        here = _user_site()
+        built = (f"; the expr was built at {self._site[0]}:"
+                 f"{self._site[1]} (in {self._site[2]})"
+                 if self._site else "")
+        at = (f" at {here[0]}:{here[1]} (in {here[2]})" if here else "")
+        raise ExprError(
+            f"an Expr has no truth value (truth-tested{at}{built}). "
+            "Lazy comparisons build element-wise graphs, so `if "
+            "expr:` or `expr in a_list` would silently evaluate or "
+            "mis-evaluate. Force explicitly instead: "
+            "bool(expr.glom()) for a size-1 result, "
+            ".any()/.all() for element-wise tests, or `is` for "
+            "object identity.")
 
     def __getitem__(self, idx) -> "Expr":
         from .slice import make_slice
@@ -732,6 +744,10 @@ def _norm_donate(donate: Sequence[Any]) -> List[DistArray]:
             raise TypeError(
                 f"donate expects DistArrays (or evaluated exprs), got "
                 f"{type(d).__name__}")
+        arr = out[-1]
+        if arr._donate_site is None:
+            # record the donating call for use-after-donate provenance
+            arr._donate_site = _user_site()
     return out
 
 
@@ -907,6 +923,16 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
             return _dispatch(expr, plan, rctx.leaves, plan.arg_order,
                              donated, mesh)
         prof.count("plan_misses")
+
+    if FLAGS.verify_evaluate:
+        # static sanity on the MISS path only (hits above stay
+        # dispatch-bound): well-formedness + donation/tiling lints,
+        # raising with user-site provenance before anything compiles
+        from ..analysis import check as _check
+
+        t0 = time.perf_counter()
+        _check(expr, donate=donated)
+        prof.record_phase("verify", time.perf_counter() - t0)
 
     from .optimize import optimize
 

@@ -48,6 +48,12 @@ def default_visit(n: Expr, new_kids: Tuple[Expr, ...]) -> Expr:
 class Pass:
     name = "base"
     flag = ""
+    # Invariant declaration for the pass checker (analysis/passes.py,
+    # FLAGS.verify_passes): a pass that prunes sub-DAGs (and with them
+    # their leaves) must opt out of strict leaf preservation. New
+    # passes inherit the strict default; see docs/ARCHITECTURE.md
+    # ("Adding an invariant to a new Pass").
+    preserves_leaves = True
 
     def enabled(self) -> bool:
         return not self.flag or getattr(FLAGS, self.flag)
@@ -63,6 +69,10 @@ class CollapseCachedPass(Pass):
 
     name = "collapse_cached"
     flag = "opt_collapse_cached"
+    # collapsing a cached node prunes its whole sub-DAG — the leaves
+    # below it legitimately disappear (their data is baked into the
+    # substituted Val leaf)
+    preserves_leaves = False
 
     def run(self, root: Expr) -> Expr:
         def visit(n: Expr, kids: Tuple[Expr, ...]) -> Expr:
@@ -179,14 +189,30 @@ def optimize(root: Expr) -> Expr:
     """Run the enabled pass stack. Only plan-cache MISSES reach this
     (expr/base.py evaluate): steady-state iterative drivers skip it
     entirely. Per-pass wall time accumulates under ``pass:<name>`` in
-    utils/profiling for the dispatch-overhead benchmark."""
+    utils/profiling for the dispatch-overhead benchmark.
+
+    With ``FLAGS.verify_passes`` (``SPARTAN_VERIFY_PASSES=1``; the
+    test suite's default) every pass is bracketed by the invariant
+    checker (analysis/passes.py): shape/dtype/leaf preservation and
+    full DAG well-formedness, failures naming the offending pass."""
     from ..utils import profiling as prof
 
     _ensure_tiling_pass()
+    verify = FLAGS.verify_passes
+    snap = None
+    if verify:
+        from ..analysis import passes as checkmod
+
+        with prof.phase("verify"):
+            snap = checkmod.snapshot(root)
     for p in _PASSES:
         if p.enabled():
             with prof.phase("pass:" + p.name):
-                root = p.run(root)
+                new_root = p.run(root)
+            if verify:
+                with prof.phase("verify"):
+                    snap = checkmod.check_pass(p, snap, new_root)
+            root = new_root
     return root
 
 

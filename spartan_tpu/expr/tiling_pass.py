@@ -32,9 +32,24 @@ class SmartTilingPass(Pass):
     flag = "opt_auto_tiling"
 
     def run(self, root: Expr) -> Expr:
+        from ..utils.config import FLAGS
         from . import tiling_cost
 
-        return tiling_cost.assign_tilings(root)
+        root = tiling_cost.assign_tilings(root)
+        if FLAGS.verify_passes:
+            # surface unresolvable / degenerate forced tilings as
+            # warnings at plan time (the choices this pass just wrote
+            # are constraints GSPMD must honor; one the mesh/shape
+            # cannot express silently degrades to padding or reshards)
+            import warnings
+
+            from ..analysis.lints import (LintWarning,
+                                          forced_tiling_findings)
+
+            for f in forced_tiling_findings(root):
+                if f.severity == "warning":
+                    warnings.warn(str(f), LintWarning, stacklevel=2)
+        return root
 
 
 register_pass(SmartTilingPass())

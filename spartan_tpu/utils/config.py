@@ -189,3 +189,24 @@ FLAGS.define_bool("check_determinism", False,
                   "Debug mode: evaluate twice and assert bitwise equality.")
 FLAGS.define_bool("use_cpp_extent", True,
                   "Use the C++ extent-algebra extension when built.")
+_verify_passes_flag = FLAGS.define_bool(
+    "verify_passes", False,
+    "Bracket every optimizer pass with the invariant checker "
+    "(analysis/passes.py): shape/dtype/leaf preservation + DAG "
+    "well-formedness, failures naming the offending pass. Runs only "
+    "on plan-cache misses. Also honored via SPARTAN_VERIFY_PASSES=1; "
+    "the test suite enables it by default.")
+FLAGS.define_bool(
+    "verify_evaluate", False,
+    "Run st.check (DAG verifier + plan-time lints: use-after-donate, "
+    "double-donation, tiling consistency) on evaluate()'s plan-cache "
+    "MISS path, before the optimizer. Hits stay dispatch-bound.")
+
+# The documented switch is SPARTAN_VERIFY_PASSES (no package prefix);
+# honor it with the same precedence as the prefixed env var, and make
+# it survive FLAGS.reset_all() like any definition-time override.
+_env = os.environ.get("SPARTAN_VERIFY_PASSES")
+if _env is not None and "SPARTAN_TPU_VERIFY_PASSES" not in os.environ:
+    _verify_passes_flag._value = _parse_bool(_env)
+    _verify_passes_flag._initial = _verify_passes_flag._value
+del _verify_passes_flag, _env

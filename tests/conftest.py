@@ -9,6 +9,10 @@ runs multi-device on one machine.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # the box presets axon (TPU); tests run CPU
+# Optimizer-pass invariant checking is ON by default under pytest
+# (analysis/passes.py): every pass in every test run is bracketed by
+# the shape/dtype/leaf/well-formedness checker. Export =0 to disable.
+os.environ.setdefault("SPARTAN_VERIFY_PASSES", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

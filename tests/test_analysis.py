@@ -1,0 +1,410 @@
+"""Graph sanitizer (ISSUE 2): DAG verifier, pass-invariant checker and
+plan-time lints — including the mutation-kill suite: deliberately
+corrupted DAGs / rewrites, each of which MUST be caught statically
+with an error naming the offending node or pass."""
+
+import importlib
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.analysis import (PassInvariantError, VerificationError,
+                                  lint, verify_dag)
+from spartan_tpu.array import tiling as tiling_mod
+from spartan_tpu.expr.base import Expr, ExprError, ValExpr, evaluate
+from spartan_tpu.utils.config import FLAGS
+
+opt_mod = importlib.import_module("spartan_tpu.expr.optimize")
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+def _arr(shape=(8, 8), seed=0):
+    rng = np.random.RandomState(seed)
+    return st.from_numpy(rng.rand(*shape).astype(np.float32))
+
+
+@pytest.fixture()
+def breaker():
+    """Register a corrupted optimizer pass for one test; always
+    unregisters (by object, not position — the tiling pass self-
+    registers mid-run)."""
+    opt_mod._ensure_tiling_pass()
+    installed = []
+
+    def install(p):
+        opt_mod.register_pass(p)
+        installed.append(p)
+        return p
+
+    yield install
+    for p in installed:
+        opt_mod._PASSES.remove(p)
+
+
+# -- well-formed DAGs pass ----------------------------------------------
+
+
+def test_clean_dag_checks_clean():
+    e = ((st.as_expr(_arr()) + 1.0) * 2.0).sum(axis=0)
+    assert verify_dag(e) == []
+    assert st.check(e) == []
+    assert st.check(e.optimized()) == []
+
+
+def test_check_accepts_tuple_roots():
+    a, b = st.as_expr(_arr(seed=1)), st.as_expr(_arr(seed=2))
+    t = st.tuple_of(a + b, (a * b).sum())
+    assert st.check(t) == []
+
+
+# -- mutation-kill: corrupted NODES caught by st.check -------------------
+
+
+def test_kill_wrong_shape_after_fusion():
+    """Corrupted declared shape on a fused map node: the verifier
+    re-derives the shape from the children and flags the divergence."""
+    e = (st.as_expr(_arr()) + 1.0) * 2.0
+    opt = e.optimized()  # map-fusion produced a fused MapExpr
+    opt._shape = (7, 7)
+    with pytest.raises(VerificationError, match="shape_mismatch"):
+        st.check(opt)
+
+
+def test_kill_dtype_drift():
+    e = st.as_expr(_arr()) + 1.0
+    e._dtype = np.dtype(np.int32)  # children still derive float32
+    with pytest.raises(VerificationError, match="dtype_mismatch"):
+        st.check(e)
+
+
+def test_kill_cycle():
+    e = st.as_expr(_arr()) + 1.0
+    e.inputs = (e, e.inputs[1])  # self-edge
+    with pytest.raises(VerificationError, match="cycle"):
+        st.check(e)
+
+
+def test_kill_bad_reduce_axis():
+    r = st.sum(st.as_expr(_arr()), axis=0)
+    r.axis = (5,)  # out of bounds for a rank-2 operand
+    with pytest.raises(VerificationError, match="bad_axis"):
+        st.check(r)
+
+
+def test_kill_bad_transpose_perm():
+    t = st.transpose(st.as_expr(_arr()))
+    t.perm = (0, 5)
+    with pytest.raises(VerificationError, match="bad_axis"):
+        st.check(t)
+
+
+def test_kill_illegal_broadcast_rewire():
+    """Rewiring a map's inputs to non-broadcastable shapes is caught
+    by reconstruction (the constructor IS the shape rule)."""
+    e = st.as_expr(_arr((8, 8))) + st.as_expr(_arr((8, 8), seed=1))
+    e.inputs = (e.inputs[0], st.as_expr(_arr((3, 5), seed=2)))
+    with pytest.raises(VerificationError):
+        st.check(e)
+
+
+def test_kill_corrupted_slice_shape():
+    s = st.as_expr(_arr())[2:6]
+    s._shape = (5, 8)  # the index derives (4, 8)
+    with pytest.raises(VerificationError, match="shape_mismatch"):
+        st.check(s)
+
+
+def test_kill_missing_sig_and_replace_children():
+    class NoHooksExpr(Expr):
+        def __init__(self, c):
+            super().__init__(c.shape, c.dtype)
+            self.c = c
+
+        def children(self):
+            return (self.c,)
+
+    bad = NoHooksExpr(st.as_expr(_arr()))
+    with pytest.raises(VerificationError) as ei:
+        st.check(bad)
+    assert "missing_sig" in str(ei.value)
+    assert "missing_replace_children" in str(ei.value)
+
+
+def test_kill_forced_tiling_rank():
+    e = st.as_expr(_arr()) + 1.0
+    e._forced_tiling = tiling_mod.row(3)  # rank 3 on a rank-2 node
+    with pytest.raises(VerificationError, match="forced_tiling_rank"):
+        st.check(e)
+
+
+def test_kill_sort_tiling_out_specs_mismatch():
+    """The ADVICE r5 #1 bug class: a declared/forced sort output tiling
+    that diverges from the collective-axis/batch-axes the kernel's
+    out_specs produce (shared helpers in ops/sort.py) is machine-caught."""
+    x = st.from_numpy(np.random.RandomState(3).rand(8, 16)
+                      .astype(np.float32), tiling=tiling_mod.col(2))
+    srt = st.sort(x, axis=1)
+    from spartan_tpu.expr.builtins import SampleSortExpr
+
+    assert isinstance(srt, SampleSortExpr)
+    assert st.check(srt) == []  # the shared-helper default is consistent
+    srt._forced_tiling = tiling_mod.Tiling(("x", "y"))
+    with pytest.raises(VerificationError, match="sort_tiling_mismatch"):
+        st.check(srt)
+
+
+# -- mutation-kill: corrupted PASSES caught by the pass checker ----------
+
+
+def test_kill_pass_wrong_root_shape(breaker):
+    class WrongShapePass(opt_mod.Pass):
+        name = "breaker_wrong_shape"
+
+        def run(self, root):
+            return root[0:4] if root.ndim == 2 else root
+
+    breaker(WrongShapePass())
+    with pytest.raises(PassInvariantError, match="breaker_wrong_shape"):
+        (st.as_expr(_arr()) + 1.0).optimized()
+
+
+def test_kill_pass_dropped_leaf(breaker):
+    class DropLeafPass(opt_mod.Pass):
+        name = "breaker_drop_leaf"
+
+        def run(self, root):
+            # rewrite a+b -> a: leaf b silently vanishes
+            return root.inputs[0] if hasattr(root, "inputs") else root
+
+    breaker(DropLeafPass())
+    a, b = st.as_expr(_arr(seed=1)), st.as_expr(_arr(seed=2))
+    with pytest.raises(PassInvariantError,
+                       match="breaker_drop_leaf.*dropped leaf"):
+        (a + b).optimized()
+
+
+def test_kill_pass_dtype_drift(breaker):
+    class DtypePass(opt_mod.Pass):
+        name = "breaker_dtype"
+
+        def run(self, root):
+            return st.astype(root, np.int32)
+
+    breaker(DtypePass())
+    with pytest.raises(PassInvariantError,
+                       match="breaker_dtype.*dtype"):
+        (st.as_expr(_arr()) * 1.5).optimized()
+
+
+def test_kill_pass_corrupted_node(breaker):
+    class CorruptNodePass(opt_mod.Pass):
+        name = "breaker_corrupt_node"
+
+        def run(self, root):
+            root._shape = tuple(reversed((root.shape[0] + 1,)
+                                         + root.shape[1:]))
+            return root
+
+    breaker(CorruptNodePass())
+    with pytest.raises(PassInvariantError, match="breaker_corrupt_node"):
+        (st.as_expr(_arr()) + 2.0).optimized()
+
+
+def test_kill_pass_invented_leaf(breaker):
+    class InventLeafPass(opt_mod.Pass):
+        name = "breaker_invent_leaf"
+
+        def run(self, root):
+            fake = st.as_expr(_arr(seed=9))
+            return root.replace_children(
+                (root.children()[0], fake)) if len(
+                    root.children()) == 2 else root
+
+    breaker(InventLeafPass())
+    with pytest.raises(PassInvariantError,
+                       match="breaker_invent_leaf.*no pre-pass"):
+        (st.as_expr(_arr(seed=1)) + st.as_expr(_arr(seed=2))).optimized()
+
+
+def test_kill_pass_swapped_scalar_constant(breaker):
+    class SwapScalarPass(opt_mod.Pass):
+        name = "breaker_swap_scalar"
+
+        def run(self, root):
+            from spartan_tpu.expr.base import ScalarExpr
+
+            if hasattr(root, "inputs") and any(
+                    isinstance(i, ScalarExpr) for i in root.inputs):
+                new = tuple(st.as_expr(99.0)
+                            if isinstance(i, ScalarExpr) else i
+                            for i in root.inputs)
+                return root.replace_children(new)
+            return root
+
+    breaker(SwapScalarPass())
+    with pytest.raises(PassInvariantError,
+                       match="breaker_swap_scalar.*no pre-pass"):
+        (st.as_expr(_arr()) * 2.5).optimized()
+
+
+def test_kill_pass_introduced_cycle(breaker):
+    class CyclePass(opt_mod.Pass):
+        name = "breaker_cycle"
+
+        def run(self, root):
+            if hasattr(root, "inputs") and len(root.inputs) == 2:
+                root.inputs = (root, root.inputs[1])
+            return root
+
+    breaker(CyclePass())
+    with pytest.raises(PassInvariantError, match="cycle"):
+        (st.as_expr(_arr(seed=1)) + st.as_expr(_arr(seed=2))).optimized()
+
+
+def test_legit_passes_still_green_under_checker():
+    """The real pass stack survives the checker on a DAG exercising
+    every registered rewrite (fusion + reduce fusion + collapse +
+    tiling)."""
+    assert FLAGS.verify_passes  # pytest default (conftest)
+    a = st.as_expr(_arr(seed=4))
+    inner = (a * 2.0 + 1.0)
+    inner_val = ValExpr(inner.evaluate())
+    out = ((inner_val + a) * (a - 0.5)).sum(axis=1)
+    got = np.asarray(out.optimized().glom())
+    an = np.asarray(a.glom())
+    ref = ((an * 2.0 + 1.0 + an) * (an - 0.5)).sum(axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+# -- plan-time donation lints -------------------------------------------
+
+
+def test_check_use_after_donate_has_provenance():
+    x = st.from_numpy(np.random.RandomState(5).rand(8, 8)
+                      .astype(np.float32)).evaluate()
+    stale = st.as_expr(x) * 2.0       # built BEFORE the donation
+    evaluate(st.as_expr(x) + 1.0, donate=[x])
+    assert x.is_donated
+    with pytest.raises(VerificationError, match="use_after_donate"):
+        st.check(stale)
+    # provenance: the donating call's user site is in the message
+    with pytest.raises(VerificationError, match="test_analysis"):
+        st.check(stale)
+
+
+def test_check_double_donation():
+    y = st.from_numpy(np.random.RandomState(6).rand(8, 8)
+                      .astype(np.float32)).evaluate()
+    y.donate()
+    e = ValExpr(y) + ValExpr(y) * 2.0  # one buffer, two leaf slots
+    with pytest.raises(VerificationError, match="double_donation"):
+        st.check(e)
+
+
+def test_check_double_donation_in_donate_list():
+    y = st.from_numpy(np.random.RandomState(7).rand(8, 8)
+                      .astype(np.float32)).evaluate()
+    e = st.as_expr(y) + 1.0
+    with pytest.raises(VerificationError, match="double_donation"):
+        st.check(e, donate=[y, y])
+
+
+def test_lint_donation_unused_is_warning():
+    y = st.from_numpy(np.ones((4, 4), np.float32)).evaluate()
+    z = st.from_numpy(np.ones((4, 4), np.float32)).evaluate()
+    e = st.as_expr(y) + 1.0
+    findings = lint(e, donate=[z])
+    assert any(f.kind == "donation_unused" and f.severity == "warning"
+               for f in findings)
+    # check() reports but does not raise on warnings
+    assert any(f.kind == "donation_unused"
+               for f in st.check(e, donate=[z]))
+
+
+def test_verify_evaluate_flag_raises_on_miss_path():
+    x = st.from_numpy(np.random.RandomState(8).rand(8, 8)
+                      .astype(np.float32)).evaluate()
+    bad = st.as_expr(x) - 1.0         # built BEFORE the donation
+    evaluate(st.as_expr(x) * 3.0, donate=[x])
+    try:
+        FLAGS.verify_evaluate = True
+        with pytest.raises(VerificationError, match="use_after_donate"):
+            bad.evaluate()
+    finally:
+        FLAGS.reset_all()
+
+
+def test_donation_caught_on_cached_plan_hit_path_with_provenance():
+    """A donated leaf feeding a CACHED plan (hit path — no optimizer,
+    no verifier) still raises before dispatch, with the donating
+    call's provenance in the message."""
+    st.clear_compile_cache()
+    xn = np.random.RandomState(9).rand(8, 8).astype(np.float32)
+    x = st.from_numpy(xn).evaluate()
+    stale = st.as_expr(x) + 1.0               # built BEFORE the donation
+    (st.as_expr(x) + 1.0).evaluate()          # plan MISS: compile + cache
+    evaluate(st.as_expr(x) + 1.0, donate=[x])  # plan HIT: donates x
+    assert x.is_donated
+    from spartan_tpu.utils import profiling
+
+    profiling.reset_counters()
+    with pytest.raises(RuntimeError, match="donated at"):
+        stale.evaluate()                      # HIT again: dead buffer
+    assert profiling.counters().get("plan_hits", 0) == 1  # really the hit path
+    with pytest.raises(RuntimeError, match="test_analysis"):
+        stale.evaluate()
+
+
+# -- tiling lints --------------------------------------------------------
+
+
+def test_lint_degenerate_tile_warning():
+    x = st.from_numpy(np.ones((2, 8), np.float32),
+                      tiling=tiling_mod.replicated(2))
+    e = st.as_expr(x) + 1.0
+    e._forced_tiling = tiling_mod.row(2)  # 2 rows split 4 ways
+    findings = lint(e)
+    assert any(f.kind == "degenerate_tile" for f in findings)
+
+
+def test_lint_unresolvable_tiling_warning():
+    x = st.from_numpy(np.ones((8, 8), np.float32))
+    e = st.as_expr(x) + 1.0
+    e._forced_tiling = tiling_mod.Tiling(("nope", None))
+    findings = lint(e)
+    assert any(f.kind == "unresolvable_tiling" for f in findings)
+
+
+# -- Expr.__bool__ satellite --------------------------------------------
+
+
+def test_bool_raises_expr_error_with_site():
+    e = st.as_expr(_arr()) + 1.0
+    with pytest.raises(ExprError, match="truth value|truth-tested"):
+        bool(e)
+    with pytest.raises(ExprError, match="test_analysis"):
+        if e:  # the classic silent-graph-build footgun
+            pass
+
+
+def test_expr_in_list_raises_loudly():
+    e = st.as_expr(_arr())
+    f = st.as_expr(_arr(seed=1))
+    with pytest.raises(ExprError):
+        e in [f]  # __eq__ builds a lazy graph; bool() must refuse
+    # identity membership is the supported spelling
+    assert any(x is e for x in [f, e])
+
+
+def test_size_one_bool_also_raises():
+    """Even size-1 exprs refuse truth-testing (it silently forced a
+    whole evaluation pre-ISSUE-2); bool(expr.glom()) is the spelling."""
+    s = st.sum(st.as_expr(_arr()))
+    with pytest.raises(ExprError):
+        bool(s)
+    assert bool(s.glom() > 0)

@@ -71,7 +71,12 @@ def test_random_dags_match_numpy():
         depth = rng.randint(3, 9)
         for _ in range(depth):
             n, e = _step(rng, n, e)
-        got = np.asarray(e.optimized().glom())
+        # the static verifier is a free oracle for every fuzzed DAG:
+        # well-formedness must hold before AND after the pass stack
+        st.check(e)
+        opt = e.optimized()
+        st.check(opt)
+        got = np.asarray(opt.glom())
         np.testing.assert_allclose(
             got, n, rtol=5e-3, atol=1e-4,
             err_msg=f"trial {trial} shape {n.shape}")
@@ -99,10 +104,13 @@ def test_random_dags_toggle_invariant():
             FLAGS.opt_auto_tiling = False
             FLAGS.opt_collapse_cached = False
             _, e_off = build()
+            st.check(e_off)
             off = np.asarray(e_off.glom())
         finally:
             FLAGS.reset_all()
         n_ref, e_on = build()
+        st.check(e_on)
         on = np.asarray(e_on.glom())
+        st.check(e_on.optimized())
         np.testing.assert_allclose(on, off, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(on, n_ref, rtol=5e-3, atol=1e-4)

@@ -1,0 +1,57 @@
+"""tools/lint_repo.py in the tier-1 flow: the codebase must stay clean
+under its own AST lint, and the lint itself must catch the two bug
+classes it exists for (direct shard_map imports; Expr subclasses
+missing the structural hooks)."""
+
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import lint_repo  # noqa: E402
+
+
+def test_repo_is_clean():
+    findings = lint_repo.run_lint()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_catches_direct_shard_map_import(tmp_path):
+    bad = tmp_path / "bad_mod.py"
+    bad.write_text(
+        "from jax.experimental.shard_map import shard_map\n"
+        "import jax\n"
+        "f = jax.experimental.shard_map\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_shard_map_imports(str(bad), tree)
+    assert any(f.rule == "shard-map-shim" for f in findings)
+
+
+def test_allows_compat_shim_import(tmp_path):
+    ok = tmp_path / "ok_mod.py"
+    ok.write_text("from ..utils.compat import shard_map\n")
+    tree = ast.parse(ok.read_text(), filename=str(ok))
+    assert lint_repo.lint_shard_map_imports(str(ok), tree) == []
+
+
+def test_catches_expr_subclass_missing_hooks(tmp_path):
+    mod = tmp_path / "exprs.py"
+    mod.write_text(
+        "class Expr:\n"
+        "    def _sig(self, ctx): raise NotImplementedError\n"
+        "    def replace_children(self, k): raise NotImplementedError\n"
+        "class GoodExpr(Expr):\n"
+        "    def _sig(self, ctx): return ('good',)\n"
+        "    def replace_children(self, k): return self\n"
+        "class InheritsGood(GoodExpr):\n"
+        "    pass\n"
+        "class BadExpr(Expr):\n"
+        "    def _sig(self, ctx): return ('bad',)\n")
+    findings = lint_repo.lint_expr_subclasses([str(mod)])
+    names = {(f.rule, "BadExpr" in f.message) for f in findings}
+    assert ("expr-subclass-hooks", True) in names
+    # the hook-complete classes (direct or inherited) are NOT flagged
+    assert not any("GoodExpr" in f.message or "InheritsGood" in f.message
+                   for f in findings)

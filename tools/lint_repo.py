@@ -1,0 +1,205 @@
+"""AST-based custom lint for the spartan_tpu codebase itself.
+
+Two repo-specific rules that generic linters cannot know:
+
+1. ``shard_map`` must be imported ONLY through the version-compat shim
+   ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
+   directly (``jax.shard_map`` / ``jax.experimental.shard_map``) at a
+   call site reintroduces the cross-version breakage the shim exists
+   to absorb.
+
+2. Every concrete ``Expr`` subclass must provide ``_sig`` and
+   ``replace_children`` somewhere below the ``Expr`` base — a subclass
+   relying on the base's ``NotImplementedError`` stubs silently breaks
+   the structural compile/plan caches and the optimizer rewrite
+   machinery the moment such a node lands in a DAG.
+
+Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
+through the tier-1 suite (tests/test_lint_repo.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "spartan_tpu")
+
+# the one module allowed to touch jax's shard_map export directly
+SHARD_MAP_SHIM = os.path.join("spartan_tpu", "utils", "compat.py")
+
+# abstract Expr layers that intentionally leave the hooks to subclasses
+_ABSTRACT_EXPRS = {"Expr"}
+
+
+class Finding:
+    def __init__(self, path: str, line: int, rule: str, message: str):
+        self.path = os.path.relpath(path, REPO)
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    __repr__ = __str__
+
+
+def _iter_py_files(root: str = PACKAGE) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        out.extend(os.path.join(dirpath, f) for f in filenames
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def _is_shim(path: str) -> bool:
+    return os.path.relpath(path, REPO) == SHARD_MAP_SHIM
+
+
+def lint_shard_map_imports(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 1: no direct jax shard_map import outside the shim."""
+    if _is_shim(path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            binds = any(a.name == "shard_map" or a.asname == "shard_map"
+                        for a in node.names)
+            from_shim = mod.endswith("utils.compat") or mod == "compat"
+            if "shard_map" in mod and not from_shim:
+                findings.append(Finding(
+                    path, node.lineno, "shard-map-shim",
+                    f"import from {mod!r}: import shard_map from "
+                    "spartan_tpu.utils.compat (the version shim), "
+                    "not from jax directly"))
+            elif binds and not from_shim:
+                findings.append(Finding(
+                    path, node.lineno, "shard-map-shim",
+                    f"binds shard_map from {mod!r}: only "
+                    "spartan_tpu.utils.compat may import it from jax"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if "shard_map" in a.name:
+                    findings.append(Finding(
+                        path, node.lineno, "shard-map-shim",
+                        f"import {a.name}: use the "
+                        "spartan_tpu.utils.compat shim"))
+        elif isinstance(node, ast.Attribute) and node.attr == "shard_map":
+            # jax.experimental.shard_map / jax.shard_map attribute use
+            root = node.value
+            parts = []
+            while isinstance(root, ast.Attribute):
+                parts.append(root.attr)
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "jax":
+                findings.append(Finding(
+                    path, node.lineno, "shard-map-shim",
+                    "attribute access on jax's shard_map: use the "
+                    "spartan_tpu.utils.compat shim"))
+    return findings
+
+
+def _collect_classes(files: List[str]
+                     ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
+    """name -> (base names, methods defined in the body, path, line).
+
+    Simple-name resolution: class names are unique across the package
+    (enforced here — a duplicate would make the lint ambiguous)."""
+    table: Dict[str, Tuple[List[str], Set[str], str, int]] = {}
+    for path in files:
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            methods = {n.name for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if node.name not in table:
+                table[node.name] = (bases, methods, path, node.lineno)
+    return table
+
+
+def lint_expr_subclasses(files: List[str]) -> List[Finding]:
+    """Rule 2: every Expr subclass defines _sig and replace_children
+    somewhere in its chain below the Expr base."""
+    table = _collect_classes(files)
+
+    def is_expr(name: str, seen: Optional[Set[str]] = None) -> bool:
+        if name in _ABSTRACT_EXPRS:
+            return True
+        if name not in table:
+            return False
+        seen = seen or set()
+        if name in seen:
+            return False
+        seen.add(name)
+        return any(is_expr(b, seen) for b in table[name][0])
+
+    def defines(name: str, method: str) -> bool:
+        """Defined in `name` or any ancestor below the Expr base."""
+        if name in _ABSTRACT_EXPRS or name not in table:
+            return False
+        bases, methods, _, _ = table[name]
+        if method in methods:
+            return True
+        return any(defines(b, method) for b in bases)
+
+    findings: List[Finding] = []
+    for name, (bases, methods, path, line) in sorted(table.items()):
+        if name in _ABSTRACT_EXPRS or not is_expr(name):
+            continue
+        for hook in ("_sig", "replace_children"):
+            if not defines(name, hook):
+                findings.append(Finding(
+                    path, line, "expr-subclass-hooks",
+                    f"Expr subclass {name} never defines {hook}; the "
+                    "base stub raises NotImplementedError and breaks "
+                    "the structural caches / optimizer rewrites"))
+    return findings
+
+
+def run_lint(root: str = PACKAGE) -> List[Finding]:
+    files = _iter_py_files(root)
+    findings: List[Finding] = []
+    for path in files:
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError as e:
+                findings.append(Finding(path, e.lineno or 0, "syntax",
+                                        str(e)))
+                continue
+        findings.extend(lint_shard_map_imports(path, tree))
+    findings.extend(lint_expr_subclasses(files))
+    return findings
+
+
+def main() -> int:
+    findings = run_lint()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("lint_repo: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
