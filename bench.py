@@ -109,16 +109,24 @@ def _plan_diag() -> dict:
     stage's JSON line + a stderr diagnostic (utils/profiling): a
     steady-state stage must show hit_rate ~1.0 and near-zero optimize
     time — the dispatch-bound contract of the plan cache."""
+    from spartan_tpu import obs
     from spartan_tpu.utils import profiling
 
     stats = profiling.plan_cache_stats()
     phases = {name: round(sec * 1e3, 2)
               for name, sec in sorted(profiling.phase_seconds().items())}
+    # per-phase p95 from the obs histograms (st.metrics()): tail
+    # latency per evaluate, where the cumulative sums above can't
+    # separate one slow dispatch from many fast ones
+    p95_ms = {name.split(":", 1)[1]: round(h["p95"] * 1e3, 3)
+              for name, h in sorted(obs.metrics()["histograms"].items())
+              if name.startswith("phase:")}
     print(f"[bench] plan cache: hits={stats['plan_hits']} "
           f"misses={stats['plan_misses']} compiles={stats['compiles']} "
           f"phase_ms={phases}", file=sys.stderr)
     return {"hits": stats["plan_hits"], "misses": stats["plan_misses"],
-            "compiles": stats["compiles"], "phase_ms": phases}
+            "compiles": stats["compiles"], "phase_ms": phases,
+            "phase_p95_ms": p95_ms}
 
 
 def worker_dot(k: int, reps: int, precision: str | None) -> None:

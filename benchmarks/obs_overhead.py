@@ -1,0 +1,94 @@
+"""Cost of the observability layer (ISSUE 3 acceptance gate): tracing
+ON vs OFF on the steady-state k-means step must cost <=5%.
+
+Each "iteration" rebuilds the k-means-step DAG and forces it through
+the plan-cache hit path (the iterative-driver shape, same as
+benchmarks/dispatch_overhead.py). With ``FLAGS.trace`` (+ metrics) ON
+every evaluate emits ~5 spans (evaluate/sign/build/dispatch/build) and
+the per-phase histogram observations; OFF, the obs layer is skipped at
+the flag check. The two arms INTERLEAVE at single-iteration
+granularity (off, on, off, on, ...) and each arm reports its median
+per-iteration time — load spikes on a shared box hit both arms
+equally instead of whichever block they land on.
+
+Also reports the k-means step's ``st.explain`` cost-analysis FLOPs (the
+plan-introspection figure run_all.py attaches to the record) and the
+spans-per-iteration count as evidence the ON arm actually traced.
+
+Prints ONE JSON line; ``obs_overhead_ratio`` <= 0.05 is the committed
+regression gate (benchmarks/thresholds.json, graded by run_all.py).
+
+Usage: python benchmarks/obs_overhead.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(iters: int = 100, n: int = 4096, d: int = 32,
+            k: int = 16) -> dict:
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr.base import ValExpr
+    from spartan_tpu.utils import profiling
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+    # warm: steady-state tiling + one compile, so both arms hit
+    c = kmeans_step(pts, ValExpr(c), k).evaluate()
+    c = kmeans_step(pts, ValExpr(c), k).evaluate()
+
+    flops = st.explain(kmeans_step(pts, ValExpr(c), k)).flops
+
+    on_times, off_times = [], []
+    try:
+        for _ in range(iters):
+            for trace_on, times in ((False, off_times), (True, on_times)):
+                FLAGS.trace = trace_on
+                FLAGS.metrics = trace_on
+                with profiling.stopwatch() as sw:
+                    c = kmeans_step(pts, ValExpr(c), k).evaluate()
+                    c.glom()  # fetch-forced: dispatch really finished
+                times.append(sw.elapsed)
+    finally:
+        FLAGS.trace = True
+        FLAGS.metrics = True
+    t_on = float(np.median(on_times))
+    t_off = float(np.median(off_times))
+
+    st.trace_clear()
+    c = kmeans_step(pts, ValExpr(c), k).evaluate()
+    spans_per_iter = len(st.trace_events())
+
+    return {
+        "metric": "obs_overhead",
+        "iters": iters,
+        "shape": [n, d, k],
+        "wall_us_per_iter_trace_on": round(t_on * 1e6, 1),
+        "wall_us_per_iter_trace_off": round(t_off * 1e6, 1),
+        "obs_overhead_ratio": round(max(0.0, t_on / t_off - 1.0), 4),
+        "spans_per_iter": spans_per_iter,
+        "kmeans_step_flops": flops,
+    }
+
+
+def main() -> None:
+    iters = 100
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    out = measure(iters=iters, n=512 if small else 4096)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,9 @@ from .parallel.mesh import (build_mesh, get_mesh, initialize_distributed,
                             set_mesh, status, use_mesh)
 from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import check, lint
+from . import obs
+from .obs import (ExplainReport, explain, metrics, trace_clear,
+                  trace_events, trace_export)
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -40,7 +43,9 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "build_mesh", "get_mesh", "set_mesh", "use_mesh", "initialize",
             "initialize_distributed", "shutdown", "status", "collectives",
             "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
-            "check", "lint"]
+            "check", "lint",
+            "obs", "explain", "ExplainReport", "metrics", "trace_export",
+            "trace_events", "trace_clear"]
            + list(_expr_all))
 
 

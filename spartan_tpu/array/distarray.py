@@ -172,7 +172,11 @@ class DistArray:
 
     def glom(self) -> np.ndarray:
         """Fetch the whole array to the host (the reference's ``glom``)."""
-        return np.asarray(jax.device_get(self.jax_array))
+        from ..utils import profiling as prof
+
+        with prof.phase("fetch") as sp:
+            sp.set(shape=self.shape, dtype=str(self.dtype))
+            return np.asarray(jax.device_get(self.jax_array))
 
     def fetch(self, region: Union[TileExtent, tuple, slice, int]
               ) -> np.ndarray:

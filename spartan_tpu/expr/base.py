@@ -17,7 +17,6 @@ from __future__ import annotations
 import itertools
 import os
 import threading
-import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -29,6 +28,7 @@ from ..array import distarray as da
 from ..array import tiling as tiling_mod
 from ..array.distarray import DistArray
 from ..array.tiling import Tiling
+from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
@@ -135,7 +135,14 @@ class Expr:
     def lower(self, env: Dict[int, Any]) -> Any:
         if self._id not in env:
             try:
-                val = self._lower(env)
+                if FLAGS.trace_annotations:
+                    # trace-time-only: device profiles (Perfetto /
+                    # TensorBoard) attribute XLA ops back to this node
+                    with jax.named_scope(
+                            f"{type(self).__name__}_{self._id}"):
+                        val = self._lower(env)
+                else:
+                    val = self._lower(env)
             except Exception as e:
                 if self._site and not getattr(e, "_expr_annotated", False):
                     try:
@@ -660,18 +667,24 @@ class _Plan:
     signature: the compile-cache key, the traced callable (donation
     variants re-jit it with ``donate_argnums``), output tilings, and
     ``arg_order`` mapping each executable argument position to the
-    position of the raw leaf that feeds it."""
+    position of the raw leaf that feeds it. ``report`` is the
+    introspection dict ``st.explain`` reads (obs/explain.py), built
+    once on the miss path and shared between the cached plan and its
+    first-run identity variant."""
 
-    __slots__ = ("key", "traced", "out_tilings", "is_tuple", "arg_order")
+    __slots__ = ("key", "traced", "out_tilings", "is_tuple", "arg_order",
+                 "report")
 
     def __init__(self, key: Tuple, traced: Callable,
                  out_tilings: Tuple[Tiling, ...], is_tuple: bool,
-                 arg_order: Tuple[int, ...]):
+                 arg_order: Tuple[int, ...],
+                 report: Optional[Dict[str, Any]] = None):
         self.key = key
         self.traced = traced
         self.out_tilings = out_tilings
         self.is_tuple = is_tuple
         self.arg_order = arg_order
+        self.report = report
 
 
 class _Exec:
@@ -800,32 +813,32 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
     """Run a plan: gather leaf args, (lazily) fetch the right donation
     variant of the executable, execute, wrap, invalidate donated
     buffers, seed the root's result cache."""
-    t0 = time.perf_counter()
-    ordered = [leaves[i] for i in order]
-    args = [_leaf_arg(leaf) for leaf in ordered]
+    with prof.phase("build"):
+        ordered = [leaves[i] for i in order]
+        args = [_leaf_arg(leaf) for leaf in ordered]
 
-    darrs: List[DistArray] = []
-    dpos: List[int] = []
-    seen: Dict[int, int] = {}
-    for j, leaf in enumerate(ordered):
-        arr = _leaf_array(leaf)
-        if arr is None:
-            continue
-        if arr._donate_next or any(arr is d for d in donated):
-            if id(arr) in seen:
-                # the same buffer feeds two argument slots: aliasing it
-                # into the output is unsafe, so don't donate either
-                # position (the wrapper is still invalidated below)
-                k = seen[id(arr)]
-                if k in dpos:
-                    dpos.remove(k)
+        darrs: List[DistArray] = []
+        dpos: List[int] = []
+        seen: Dict[int, int] = {}
+        for j, leaf in enumerate(ordered):
+            arr = _leaf_array(leaf)
+            if arr is None:
                 continue
-            seen[id(arr)] = j
-            dpos.append(j)
-            if not any(arr is d for d in darrs):
-                darrs.append(arr)
-    donate_key = frozenset(dpos)
-    prof.record_phase("build", time.perf_counter() - t0)
+            if arr._donate_next or any(arr is d for d in donated):
+                if id(arr) in seen:
+                    # the same buffer feeds two argument slots: aliasing
+                    # it into the output is unsafe, so don't donate
+                    # either position (the wrapper is still invalidated
+                    # below)
+                    k = seen[id(arr)]
+                    if k in dpos:
+                        dpos.remove(k)
+                    continue
+                seen[id(arr)] = j
+                dpos.append(j)
+                if not any(arr is d for d in darrs):
+                    darrs.append(arr)
+        donate_key = frozenset(dpos)
 
     with _cache_lock:
         ex = _compile_cache.get(plan.key + (donate_key,))
@@ -855,10 +868,10 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
             return ex.jitted(*args)
 
     fresh = not ex.warm
-    t0 = time.perf_counter()
-    out = run()
-    prof.record_phase("compile" if fresh else "dispatch",
-                      time.perf_counter() - t0)
+    with prof.phase("compile" if fresh else "dispatch") as dsp:
+        out = run()
+        if dpos:
+            dsp.set(donated=sorted(dpos))
     ex.warm = True
 
     if FLAGS.check_determinism and not dpos:  # a donated arg is gone
@@ -868,18 +881,24 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
             if not bool(jnp.all(o1 == o2)):
                 raise AssertionError("nondeterministic evaluation detected")
 
-    t0 = time.perf_counter()
-    if plan.is_tuple:
-        result: Any = tuple(DistArray(o, t, mesh)
-                            for o, t in zip(out, plan.out_tilings))
-    else:
-        result = DistArray(out, plan.out_tilings[0], mesh)
-    for arr in darrs:
-        arr._release_donated()
-    if darrs:
-        prof.count("donated_dispatches")
-    expr._result = result
-    prof.record_phase("build", time.perf_counter() - t0)
+    with prof.phase("build"):
+        if plan.is_tuple:
+            result: Any = tuple(DistArray(o, t, mesh)
+                                for o, t in zip(out, plan.out_tilings))
+        else:
+            result = DistArray(out, plan.out_tilings[0], mesh)
+        for arr in darrs:
+            arr._release_donated()
+        if darrs:
+            prof.count("donated_dispatches")
+        if plan.report is not None:
+            don = plan.report.get("donation")
+            if don is not None:
+                don["last_donated_args"] = sorted(dpos)
+                if darrs:
+                    don["donated_dispatches"] = (
+                        don.get("donated_dispatches", 0) + 1)
+        expr._result = result
     return result
 
 
@@ -907,46 +926,78 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
     mesh = mesh_mod.get_mesh()
     donated = _norm_donate(donate)
 
-    rctx: Optional[_PlanSigCtx] = None
-    plan_key: Optional[Tuple] = None
-    if FLAGS.plan_cache:
-        t0 = time.perf_counter()
-        rctx = _PlanSigCtx()
-        raw_sig = rctx.of(expr)
-        plan_key = (raw_sig, _opt_flags_key(),
-                    tuple(sorted(mesh.shape.items())))
-        prof.record_phase("sign", time.perf_counter() - t0)
-        with _cache_lock:
-            plan = _plan_cache.get(plan_key)
-        if plan is not None:
-            prof.count("plan_hits")
-            return _dispatch(expr, plan, rctx.leaves, plan.arg_order,
-                             donated, mesh)
-        prof.count("plan_misses")
+    with prof.span("evaluate") as esp:
+        if FLAGS.trace:  # skip the label f-strings when not recording
+            site = expr._site
+            esp.set(root=f"{type(expr).__name__}#{expr._id}",
+                    site=(f"{site[0]}:{site[1]}" if site else None))
+        rctx: Optional[_PlanSigCtx] = None
+        plan_key: Optional[Tuple] = None
+        if FLAGS.plan_cache:
+            with prof.phase("sign"):
+                rctx = _PlanSigCtx()
+                raw_sig = rctx.of(expr)
+                plan_key = (raw_sig, _opt_flags_key(),
+                            tuple(sorted(mesh.shape.items())))
+            if FLAGS.trace:  # key_hash re-hashes the signature tuple:
+                esp.set(plan_key=key_hash(plan_key))  # skip when off
+            with _cache_lock:
+                plan = _plan_cache.get(plan_key)
+            if plan is not None:
+                prof.count("plan_hits")
+                esp.set(cache="hit")
+                return _dispatch(expr, plan, rctx.leaves, plan.arg_order,
+                                 donated, mesh)
+            prof.count("plan_misses")
+            esp.set(cache="miss")
 
-    if FLAGS.verify_evaluate:
-        # static sanity on the MISS path only (hits above stay
-        # dispatch-bound): well-formedness + donation/tiling lints,
-        # raising with user-site provenance before anything compiles
-        from ..analysis import check as _check
+        if FLAGS.verify_evaluate:
+            # static sanity on the MISS path only (hits above stay
+            # dispatch-bound): well-formedness + donation/tiling lints,
+            # raising with user-site provenance before anything compiles
+            from ..analysis import check as _check
 
-        t0 = time.perf_counter()
-        _check(expr, donate=donated)
-        prof.record_phase("verify", time.perf_counter() - t0)
+            with prof.phase("verify"):
+                _check(expr, donate=donated)
 
+        plan, dag, leaves = _build_plan(expr, mesh, rctx, plan_key)
+        if plan is None:
+            # the optimizer collapsed the root onto an already-held
+            # result (cached sub-DAG frontier covered everything)
+            expr._result = dag._result
+            return dag._result
+
+        # this first run dispatches through the same path a hit takes,
+        # with identity arg order over the OPTIMIZED leaves
+        result = _dispatch(expr, plan, leaves, plan.arg_order, donated,
+                           mesh)
+        dag._result = result
+        return result
+
+
+def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
+                plan_key: Optional[Tuple]
+                ) -> Tuple[Optional[_Plan], Expr, Optional[List[Expr]]]:
+    """The plan-cache MISS pipeline, shared by ``evaluate()`` and
+    ``st.explain`` (obs/explain.py): optimize -> sign the optimized DAG
+    -> build the traced function + output tilings -> memoize the plan
+    (with its introspection report) under the raw signature.
+
+    Returns ``(plan, dag, leaves)`` where ``plan.arg_order`` is the
+    identity over the OPTIMIZED leaves (the first dispatch's order);
+    ``(None, dag, None)`` when the optimized DAG already carries a
+    result and there is nothing to compile."""
     from .optimize import optimize
 
-    t0 = time.perf_counter()
-    dag = optimize(expr)
-    prof.record_phase("optimize", time.perf_counter() - t0)
+    passes_report: List[Dict[str, Any]] = []
+    with prof.phase("optimize"):
+        dag = optimize(expr, report=passes_report)
     if dag._result is not None:
-        expr._result = dag._result
-        return dag._result
+        return None, dag, None
 
-    t0 = time.perf_counter()
-    ctx = _SigCtx()
-    root_sig = ctx.of(dag)
-    prof.record_phase("sign", time.perf_counter() - t0)
+    with prof.phase("sign"):
+        ctx = _SigCtx()
+        root_sig = ctx.of(dag)
     leaves = ctx.leaves
     is_tuple = isinstance(dag, TupleExpr)
     if is_tuple:
@@ -972,22 +1023,22 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
         return jax.lax.with_sharding_constraint(out, out_shardings[0])
 
     identity = tuple(range(len(leaves)))
-    plan = _Plan(key, traced, out_tilings, is_tuple, identity)
-
+    raw_order: Optional[Tuple[int, ...]] = None
     if rctx is not None and plan_key is not None:
         raw_order = _arg_order(rctx.leaves, leaves)
+    report = build_plan_report(expr, dag, leaves, plan_key,
+                               passes_report, out_tilings, raw_order)
+    plan = _Plan(key, traced, out_tilings, is_tuple, identity, report)
+
+    if rctx is not None and plan_key is not None:
         if raw_order is not None:
-            stored = _Plan(key, traced, out_tilings, is_tuple, raw_order)
+            stored = _Plan(key, traced, out_tilings, is_tuple, raw_order,
+                           report)
             with _cache_lock:
                 _plan_cache.setdefault(plan_key, stored)
         else:
             prof.count("plan_uncacheable")
-
-    # this first run dispatches through the same path a hit takes, with
-    # identity arg order over the OPTIMIZED leaves
-    result = _dispatch(expr, plan, leaves, identity, donated, mesh)
-    dag._result = result
-    return result
+    return plan, dag, leaves
 
 
 _eval_shape_cache: Dict[Tuple, Any] = {}

@@ -20,11 +20,16 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import functools
+
 import jax.numpy as jnp
 import numpy as np
 
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
+from ..obs import trace as obs_trace
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
 from .base import Expr, ValExpr, as_expr
 
 
@@ -110,6 +115,7 @@ class LoopExpr(Expr):
                         self.index_expr)
 
     def _lower(self, env: Dict[int, Any]) -> Any:
+        import jax
         from jax import lax
 
         n = self.n_expr.lower(env)
@@ -118,6 +124,7 @@ class LoopExpr(Expr):
         inits = tuple(
             jnp.asarray(i.lower(env), b.dtype)
             for i, b in zip(self.init, self.body_roots))
+        trace_steps = FLAGS.trace_loop_steps
 
         def body(i: Any, carry: Tuple[Any, ...]) -> Tuple[Any, ...]:
             benv = dict(env)
@@ -125,12 +132,22 @@ class LoopExpr(Expr):
                 benv[self.index_expr._id] = i
             for ce, cv in zip(self.carries, carry):
                 benv[ce._id] = cv
-            return tuple(b.lower(benv) for b in self.body_roots)
+            if trace_steps:
+                # per-iteration host visibility: a debug callback marks
+                # the host clock each step; obs/trace turns consecutive
+                # marks into "loop_step" spans with REAL per-step times
+                # (the flag is part of _sig, so toggling recompiles)
+                jax.debug.callback(
+                    functools.partial(obs_trace.record_loop_step,
+                                      f"loop#{self._id}"), i)
+            with jax.named_scope("st_loop_body"):
+                return tuple(b.lower(benv) for b in self.body_roots)
 
         return lax.fori_loop(0, n, body, inits)
 
     def _sig(self, ctx) -> Tuple:
-        head = (("loop", ctx.of(self.n_expr))
+        head = (("loop", bool(FLAGS.trace_loop_steps),
+                 ctx.of(self.n_expr))
                 + tuple(ctx.of(i) for i in self.init))
         # bind the carries for the body traversal (see CarryExpr._sig)
         frames = getattr(ctx, "_loop_binders", None)
@@ -169,19 +186,26 @@ class LoopItemExpr(Expr):
         # loop-carry donation: with donate_init the init buffers feed
         # only this loop and die with it — release them to the dispatch
         donate = tuple(donate) + getattr(self.loop, "_donate_init", ())
-        siblings = getattr(self.loop, "_items", None)
-        # identity check, NOT `in`: Expr.__eq__ builds comparison exprs
-        if (siblings and len(siblings) > 1
-                and any(s is self for s in siblings)):
-            from .base import TupleExpr, evaluate as eval_root
+        n = self.loop.n_expr
+        static_n = getattr(n, "pyvalue", None)
+        label = f"loop#{self.loop._id}"
+        if FLAGS.trace_loop_steps:
+            obs_trace.loop_steps_begin(label)  # anchor step 0's span
+        with prof.span("loop", loop=label, n=static_n,
+                       carries=len(self.loop.init)):
+            siblings = getattr(self.loop, "_items", None)
+            # identity check, NOT `in`: Expr.__eq__ builds comparisons
+            if (siblings and len(siblings) > 1
+                    and any(s is self for s in siblings)):
+                from .base import TupleExpr, evaluate as eval_root
 
-            results = eval_root(TupleExpr(siblings), donate=donate)
-            for item, res in zip(siblings, results):
-                item._result = res
-            return self._result
-        from .base import evaluate as eval_root
+                results = eval_root(TupleExpr(siblings), donate=donate)
+                for item, res in zip(siblings, results):
+                    item._result = res
+                return self._result
+            from .base import evaluate as eval_root
 
-        return eval_root(self, donate=donate)
+            return eval_root(self, donate=donate)
 
     force = evaluate
 

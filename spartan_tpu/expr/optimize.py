@@ -12,7 +12,7 @@ here.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.config import FLAGS
 from .base import Expr, ValExpr
@@ -185,11 +185,16 @@ def _ensure_tiling_pass() -> None:
     from . import tiling_pass  # noqa: F401  (self-registers on import)
 
 
-def optimize(root: Expr) -> Expr:
+def optimize(root: Expr, report: Optional[List[Dict]] = None) -> Expr:
     """Run the enabled pass stack. Only plan-cache MISSES reach this
     (expr/base.py evaluate): steady-state iterative drivers skip it
     entirely. Per-pass wall time accumulates under ``pass:<name>`` in
-    utils/profiling for the dispatch-overhead benchmark.
+    utils/profiling (span + histogram) for the dispatch-overhead
+    benchmark and the trace ring.
+
+    ``report``: optional list; one dict per enabled pass is appended
+    (``name`` / ``nodes_before`` / ``nodes_after`` / ``seconds``) —
+    the per-pass node-delta record ``st.explain`` shows.
 
     With ``FLAGS.verify_passes`` (``SPARTAN_VERIFY_PASSES=1``; the
     test suite's default) every pass is bracketed by the invariant
@@ -207,8 +212,13 @@ def optimize(root: Expr) -> Expr:
             snap = checkmod.snapshot(root)
     for p in _PASSES:
         if p.enabled():
-            with prof.phase("pass:" + p.name):
+            before = len(dag_nodes(root)) if report is not None else 0
+            with prof.phase("pass:" + p.name) as psp:
                 new_root = p.run(root)
+            if report is not None:
+                report.append({"name": p.name, "nodes_before": before,
+                               "nodes_after": len(dag_nodes(new_root)),
+                               "seconds": psp.seconds})
             if verify:
                 with prof.phase("verify"):
                     snap = checkmod.check_pass(p, snap, new_root)

@@ -381,6 +381,10 @@ def assign_tilings(root: Expr) -> Expr:
         if isinstance(node, (ValExpr, ScalarExpr)):
             return
         entry = table[node._id].get(t)
+        if entry is not None and getattr(node, "_plan_cost", None) is None:
+            # cost-model estimate for the chosen tiling (bytes-equivalent
+            # units, subtree-cumulative) — surfaced by st.explain
+            node._plan_cost = entry[0]
         # Constrain only MATERIALIZATION points: GEMMs (whose lowering
         # derives operand layouts from the chosen plan) and the root.
         # Forcing every intermediate (e.g. a transpose) pins layouts XLA
@@ -460,10 +464,10 @@ def calibrate_flop_weight(n: int = 512, iters: int = 5,
     calibration transfers across shapes (unlike the round-4
     output-bytes weight, which baked n into the constant). Record
     per-platform values via ``--tiling_flop_weight``."""
-    import time as _time
-
     import jax
     import jax.numpy as jnp
+
+    from ..utils import profiling as prof
 
     mesh = mesh or mesh_mod.get_mesh()
     p = _mesh_n(mesh)
@@ -472,20 +476,20 @@ def calibrate_flop_weight(n: int = 512, iters: int = 5,
     x = jnp.asarray(np.random.RandomState(0).rand(n, n).astype(np.float32))
     mm = jax.jit(lambda a: a @ a)
     jax.block_until_ready(mm(x))
-    t0 = _time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready(mm(x))
-    t_mm = (_time.perf_counter() - t0) / iters
+    with prof.stopwatch() as sw:
+        for _ in range(iters):
+            jax.block_until_ready(mm(x))
+    t_mm = sw.elapsed / iters
 
     row = tiling_mod.row(2)
     rep = tiling_mod.replicated(2)
     xs = jax.device_put(x, row.sharding(mesh))
     gather = jax.jit(lambda a: a, out_shardings=rep.sharding(mesh))
     jax.block_until_ready(gather(xs))
-    t0 = _time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready(gather(xs))
-    t_ag = (_time.perf_counter() - t0) / iters
+    with prof.stopwatch() as sw:
+        for _ in range(iters):
+            jax.block_until_ready(gather(xs))
+    t_ag = sw.elapsed / iters
     if t_ag <= 0:
         return _flop_weight()
     flops = 2.0 * n * n * n

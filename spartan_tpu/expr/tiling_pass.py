@@ -32,10 +32,15 @@ class SmartTilingPass(Pass):
     flag = "opt_auto_tiling"
 
     def run(self, root: Expr) -> Expr:
+        from ..utils import profiling as prof
         from ..utils.config import FLAGS
         from . import tiling_cost
 
-        root = tiling_cost.assign_tilings(root)
+        # a dedicated "tiling" sub-span (nested under "pass:auto_tiling"
+        # in the trace ring): the candidate table + DP is the dominant
+        # per-miss planning cost and deserves its own line in traces
+        with prof.phase("tiling"):
+            root = tiling_cost.assign_tilings(root)
         if FLAGS.verify_passes:
             # surface unresolvable / degenerate forced tilings as
             # warnings at plan time (the choices this pass just wrote

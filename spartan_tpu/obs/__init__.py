@@ -1,0 +1,42 @@
+"""Observability: tracing + metrics + plan introspection.
+
+The subsystem that makes every ``evaluate()`` explainable after the
+fact (the production-debugging layer the reference only had as
+FLAGS-gated cProfile dumps — SURVEY.md §5):
+
+* :mod:`trace` — nested host-side spans for the whole plan lifecycle
+  (build -> sign -> optimize -> per-pass -> tiling -> compile ->
+  dispatch -> fetch), ring-buffered and exportable as Chrome
+  trace-event JSON (``st.trace_export(path)``; load in Perfetto).
+  ``jax.named_scope`` per expr node maps device profiles back to the
+  DAG.
+* :mod:`metrics` — typed counters / gauges / histograms replacing the
+  raw dicts of ``utils/profiling`` (which now shims onto it):
+  per-phase p50/p95/max, plan-cache hit ratio, donated dispatches,
+  device memory high-water. ``st.metrics()`` snapshots as JSON;
+  ``st.metrics(fmt="prometheus")`` renders Prometheus text format.
+* :mod:`explain` — ``st.explain(expr)``: passes applied (with node
+  deltas), chosen tilings + cost-model estimates, reshard edges, leaf
+  order, donation slots, and ``cost_analysis()`` FLOPs for the plan —
+  instant for plan-cache hits (the report is built once, on the miss
+  path).
+
+Import discipline: ``obs`` sits BELOW the expr/array layers (only
+``utils/config`` above it), so every subsystem can emit spans/metrics
+without import cycles; ``explain`` reaches into the expr layer lazily.
+"""
+
+from . import metrics as _metrics_mod
+from . import trace as _trace_mod
+from .explain import ExplainReport, explain
+from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
+from .trace import Span, span
+
+metrics = _metrics_mod.snapshot
+trace_export = _trace_mod.export
+trace_events = _trace_mod.events
+trace_clear = _trace_mod.clear
+
+__all__ = ["span", "Span", "trace_export", "trace_events", "trace_clear",
+           "metrics", "REGISTRY", "Registry", "Counter", "Gauge",
+           "Histogram", "explain", "ExplainReport"]

@@ -1,0 +1,335 @@
+"""Plan introspection: make every ``evaluate()`` explainable after the
+fact.
+
+``st.explain(expr)`` answers "what will (or did) this evaluate do":
+which optimizer passes ran and how they changed the DAG, which tiling
+the cost model chose per node (with its cost estimate), where reshard
+collectives were planned, the leaf -> executable argument order, the
+donation slots of the last dispatch, and the compiled program's
+``cost_analysis()`` FLOPs/bytes.
+
+The structured report is built ONCE, on the plan-cache miss path
+(``expr/base._build_plan`` calls :func:`build_plan_report` and stores
+the dict on the ``_Plan``), so explaining a cached plan is a signature
+traversal + dict copy — no optimizer re-run. Explaining a never-
+evaluated expr builds (and caches) its plan without dispatching, so
+the following ``evaluate()`` hits. The ``cost_analysis`` field is the
+one lazy part: the first request AOT-lowers and XLA-compiles the
+plan's traced function (memoized on the plan; pass ``cost=False`` to
+skip).
+
+Top-level imports stay off the expr layer (cycle: expr/base imports
+this module); expr/tiling helpers load lazily inside the builders.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def key_hash(key: Any) -> Optional[str]:
+    """Short printable digest of a plan/compile cache key (process-
+    stable, matching what evaluate spans carry)."""
+    if key is None:
+        return None
+    return format(hash(key) & 0xFFFFFFFFFFFF, "012x")
+
+
+def _label(node: Any) -> str:
+    return f"{type(node).__name__}#{node._id}"
+
+
+def _site_str(site: Optional[Tuple[str, int, str]]) -> Optional[str]:
+    return f"{site[0]}:{site[1]} (in {site[2]})" if site else None
+
+
+def _leaf_entries(leaves: Sequence[Any]) -> List[Dict[str, Any]]:
+    from ..expr.base import ScalarExpr, ValExpr
+
+    out = []
+    for pos, leaf in enumerate(leaves):
+        if isinstance(leaf, ScalarExpr):
+            out.append({"pos": pos, "kind": "scalar",
+                        "weak_kind": leaf.weak_kind})
+        else:
+            kind = "val" if isinstance(leaf, ValExpr) else "cached"
+            out.append({"pos": pos, "kind": kind, "shape": leaf.shape,
+                        "dtype": str(leaf.dtype),
+                        "tiling": leaf.out_tiling().axes})
+    return out
+
+
+def _arg_specs(leaves: Sequence[Any]) -> List[Any]:
+    """Abstract argument specs matching the plan's traced function —
+    enough to AOT-lower for cost_analysis without real buffers."""
+    import jax
+
+    from ..expr.base import ScalarExpr
+
+    specs: List[Any] = []
+    for leaf in leaves:
+        if isinstance(leaf, ScalarExpr):
+            specs.append(leaf.pyvalue)
+        else:
+            specs.append(jax.ShapeDtypeStruct(leaf.shape, leaf.dtype))
+    return specs
+
+
+def _tiling_entries(dag: Any) -> List[Dict[str, Any]]:
+    from ..expr.base import ScalarExpr, ValExpr
+    from ..expr.optimize import dag_nodes
+
+    out = []
+    for n in dag_nodes(dag):
+        if isinstance(n, (ValExpr, ScalarExpr)):
+            continue
+        try:
+            tiling = n.out_tiling().axes
+        except Exception:
+            tiling = None
+        entry: Dict[str, Any] = {
+            "node": _label(n), "shape": n.shape, "dtype": str(n.dtype),
+            "tiling": tiling, "forced": n._forced_tiling is not None,
+        }
+        cost = getattr(n, "_plan_cost", None)
+        if cost is not None:
+            entry["cost_estimate"] = round(float(cost), 3)
+        plan = getattr(n, "_dot_plan", None)
+        if plan is not None:
+            entry["contraction"] = {"grid": plan[0].axes,
+                                    "strategy": plan[1]}
+        site = _site_str(n._site)
+        if site is not None:
+            entry["site"] = site
+        out.append(entry)
+    return out
+
+
+def _reshard_edges(dag: Any) -> List[Dict[str, Any]]:
+    """Edges where the plan demands an operand layout different from
+    the child's own output layout — the points a resharding collective
+    (all-gather / all-to-all) must materialize."""
+    from ..expr import tiling_cost
+    from ..expr.optimize import dag_nodes
+    from ..parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.get_mesh()
+    edges = []
+    for n in dag_nodes(dag):
+        kids = n.children()
+        if not kids:
+            continue
+        try:
+            t = n.out_tiling()
+        except Exception:
+            continue
+        cview = tiling_cost._contraction_view(n)
+        reqs: List[Optional[Any]] = [None] * len(kids)
+        if cview is not None and getattr(n, "_dot_plan", None) is not None:
+            grid, strategy = n._dot_plan
+            try:
+                reqs = list(cview[1](grid, strategy))
+            except Exception:
+                reqs = [None] * len(kids)
+        else:
+            for i, c in enumerate(kids):
+                try:
+                    reqs[i] = tiling_cost._operand_requirement(n, t, c, i)
+                except Exception:
+                    reqs[i] = None
+        for i, (c, req) in enumerate(zip(kids, reqs)):
+            if req is None:
+                continue
+            try:
+                src = c.out_tiling().axes
+            except Exception:
+                continue
+            if src == req.axes:
+                continue
+            nbytes = float(c.size) * c.dtype.itemsize
+            try:
+                moved = tiling_cost.reshard_cost(
+                    c.out_tiling(), req, nbytes, mesh)
+            except Exception:
+                moved = None
+            if moved == 0.0:
+                continue  # e.g. replicated source: no wire traffic
+            edges.append({
+                "edge": f"{_label(c)} -> {_label(n)}", "operand": i,
+                "src": src, "dst": req.axes,
+                "bytes_per_chip": (round(moved, 1)
+                                   if moved is not None else None),
+            })
+    return edges
+
+
+def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
+                      plan_key: Any, passes: List[Dict[str, Any]],
+                      out_tilings: Sequence[Any],
+                      arg_order: Optional[Tuple[int, ...]]
+                      ) -> Dict[str, Any]:
+    """The structured per-plan report, built on the miss path and
+    stored on the ``_Plan`` (shared by the cached and the identity
+    variant, so a cache-hit ``st.explain`` is instant)."""
+    report: Dict[str, Any] = {
+        "root": _label(expr),
+        "site": _site_str(expr._site),
+        "plan_key": key_hash(plan_key),
+        "passes": passes,
+        "optimized_nodes": (passes[-1]["nodes_after"] if passes
+                            else None),
+        "leaves": _leaf_entries(leaves),
+        "arg_order": (list(arg_order) if arg_order is not None
+                      else None),
+        "out_tilings": [t.axes for t in out_tilings],
+        "tilings": _tiling_entries(dag),
+        "reshard_edges": _reshard_edges(dag),
+        "donation": {"last_donated_args": None, "donated_dispatches": 0},
+        "arg_specs": _arg_specs(leaves),
+        "cost_analysis": None,
+    }
+    return report
+
+
+def _compute_cost_analysis(plan: Any) -> Dict[str, float]:
+    """AOT-lower + compile the plan's traced function over abstract
+    arg specs and read XLA's FLOPs/bytes estimate. Memoized on the
+    plan report by :func:`explain`."""
+    import jax
+
+    specs = plan.report.get("arg_specs") or []
+    compiled = jax.jit(plan.traced).lower(*specs).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, list):
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis or {})
+
+
+class ExplainReport:
+    """Structured plan report with a pretty ``str()`` rendering.
+
+    ``.data`` is the raw dict; the common fields are attributes:
+    ``cache`` ('hit' / 'miss' / 'evaluated'), ``plan_key``,
+    ``passes``, ``tilings``, ``reshard_edges``, ``leaves``,
+    ``arg_order``, ``donation``, ``cost_analysis``, ``flops``.
+    """
+
+    def __init__(self, data: Dict[str, Any]):
+        self.data = data
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["data"][name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dict(self.data)
+        out.pop("arg_specs", None)  # not JSON-serializable, internal
+        return out
+
+    @property
+    def flops(self) -> Optional[float]:
+        ca = self.data.get("cost_analysis")
+        return ca.get("flops") if ca else None
+
+    def __str__(self) -> str:
+        d = self.data
+        lines = [f"plan for {d.get('root')} "
+                 f"[cache {d.get('cache', '?')}, "
+                 f"key {d.get('plan_key')}]"]
+        if d.get("site"):
+            lines.append(f"  built at {d['site']}")
+        if d.get("passes"):
+            lines.append("  passes:")
+            for p in d["passes"]:
+                delta = p["nodes_after"] - p["nodes_before"]
+                lines.append(
+                    f"    {p['name']:<18} {p['nodes_before']:>4} -> "
+                    f"{p['nodes_after']:<4} nodes ({delta:+d}) "
+                    f"{p.get('seconds', 0.0) * 1e3:8.2f} ms")
+        if d.get("tilings"):
+            lines.append("  tilings:")
+            for t in d["tilings"]:
+                extra = ""
+                if t.get("forced"):
+                    extra += " FORCED"
+                if t.get("cost_estimate") is not None:
+                    extra += f" cost~{t['cost_estimate']}"
+                if t.get("contraction"):
+                    cstrat = t["contraction"]
+                    extra += (f" contraction(grid={cstrat['grid']}, "
+                              f"axis={cstrat['strategy']})")
+                lines.append(f"    {t['node']:<22} {str(t['shape']):<16} "
+                             f"{str(t['tiling']):<14}{extra}")
+        if d.get("reshard_edges"):
+            lines.append("  reshard edges:")
+            for e in d["reshard_edges"]:
+                lines.append(
+                    f"    {e['edge']}: {e['src']} -> {e['dst']} "
+                    f"(~{e['bytes_per_chip']} B/chip)")
+        if d.get("leaves") is not None:
+            lines.append(f"  leaves: {len(d['leaves'])} "
+                         f"(arg order {d.get('arg_order')})")
+        don = d.get("donation") or {}
+        if don.get("last_donated_args"):
+            lines.append(
+                f"  donation: args {don['last_donated_args']} donated "
+                f"({don['donated_dispatches']} donated dispatch(es))")
+        ca = d.get("cost_analysis")
+        if ca:
+            lines.append(
+                f"  cost_analysis: flops={ca.get('flops')} "
+                f"bytes={ca.get('bytes accessed')}")
+        elif ca is None and "cost_analysis" in d:
+            lines.append("  cost_analysis: (skipped; "
+                         "st.explain(expr, cost=True) to compile)")
+        return "\n".join(lines)
+
+    __repr__ = __str__
+
+
+def explain(expr: Any, cost: bool = True) -> ExplainReport:
+    """Explain the evaluation plan for ``expr`` (see module docstring).
+
+    ``cost=True`` (default) also fills ``cost_analysis`` — the first
+    call per plan pays an AOT XLA compile; later calls reuse it.
+    Never dispatches: explaining an unevaluated expr pre-plans it (the
+    next ``evaluate()`` is a plan-cache hit)."""
+    from ..expr import base
+    from ..parallel import mesh as mesh_mod
+
+    root = expr if isinstance(expr, base.Expr) else base.as_expr(expr)
+    if root._result is not None:
+        return ExplainReport({
+            "root": _label(root), "site": _site_str(root._site),
+            "cache": "evaluated", "plan_key": None, "passes": [],
+            "tilings": [], "reshard_edges": [], "leaves": None,
+            "arg_order": None, "donation": {}, "cost_analysis": None,
+            "note": "expr already carries a result; nothing to plan",
+        })
+
+    mesh = mesh_mod.get_mesh()
+    rctx = base._PlanSigCtx()
+    raw_sig = rctx.of(root)
+    plan_key = (raw_sig, base._opt_flags_key(),
+                tuple(sorted(mesh.shape.items())))
+    with base._cache_lock:
+        plan = base._plan_cache.get(plan_key)
+    status = "hit" if plan is not None else "miss"
+    if plan is None:
+        plan, dag, _ = base._build_plan(root, mesh, rctx, plan_key)
+        if plan is None:  # optimizer collapsed to an already-held result
+            return ExplainReport({
+                "root": _label(root), "site": _site_str(root._site),
+                "cache": "evaluated", "plan_key": key_hash(plan_key),
+                "passes": [], "tilings": [], "reshard_edges": [],
+                "leaves": None, "arg_order": None, "donation": {},
+                "cost_analysis": None,
+                "note": "optimized DAG already carries a result",
+            })
+    if cost and plan.report.get("cost_analysis") is None:
+        plan.report["cost_analysis"] = _compute_cost_analysis(plan)
+    data = dict(plan.report)
+    data["cache"] = status
+    return ExplainReport(data)

@@ -1,0 +1,287 @@
+"""Typed metrics registry: counters / gauges / histograms.
+
+Replaces the raw process-global dicts that ``utils/profiling.py``
+accumulated for PR 1 (the old ``count``/``counters``/``phase_seconds``
+API survives there as shims over this registry). Three instrument
+types, all behind one lock (the ``_stats_lock`` pattern):
+
+* :class:`Counter` — monotonically increasing int
+  (``plan_hits``, ``compiles``, ``donated_dispatches``, ...);
+* :class:`Gauge` — point-in-time value with a tracked high-water mark
+  (``device_peak_bytes_in_use``);
+* :class:`Histogram` — streaming count/sum/max plus a bounded sample
+  window for p50/p95 (per-phase wall times: ``phase:sign``,
+  ``phase:dispatch``, ``phase:pass:<name>``, ...).
+
+``snapshot()`` exports the whole registry as JSON-ready dicts;
+``prometheus()`` renders Prometheus text exposition format. Both are
+reachable through the public ``st.metrics()``. ``FLAGS.metrics``
+gates recording at the ``utils/profiling`` shim layer (direct
+instrument handles always record).
+
+Imports only the config layer — usable from any subsystem without
+cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from ..utils.config import FLAGS
+
+# define() returns the Flag; hot shims (utils/profiling.count /
+# record_phase) read ._value directly to skip FLAGS.__getattr__.
+METRICS_FLAG = FLAGS.define_bool(
+    "metrics", True,
+    "Record counters/gauges/phase histograms into the obs metrics "
+    "registry (st.metrics). Gates the utils/profiling shim layer "
+    "(count/record_phase); plan-cache behavior is unaffected either "
+    "way, only its visibility.")
+FLAGS.define_int(
+    "metrics_hist_window", 2048,
+    "Samples retained per histogram for the p50/p95 estimates "
+    "(count/sum/max are exact and unwindowed).")
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._value = 0
+        self._lock = lock
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def _reset(self) -> None:
+        self._value = 0
+
+
+class Gauge:
+    __slots__ = ("name", "help", "_value", "_max", "_lock")
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._value: float = 0.0
+        self._max: float = 0.0
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    @property
+    def high_water(self) -> float:
+        return self._max
+
+    def _reset(self) -> None:
+        self._value = 0.0
+        self._max = 0.0
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile over a non-empty sorted sample list."""
+    i = min(len(samples) - 1, max(0, int(round(q * (len(samples) - 1)))))
+    return samples[i]
+
+
+class Histogram:
+    """Streaming count/sum/max (exact) + a bounded recent-sample window
+    for p50/p95 (approximate once the window wraps)."""
+
+    __slots__ = ("name", "help", "count", "total", "vmax", "_samples",
+                 "_lock")
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self.count = 0
+        self.total = 0.0
+        self.vmax = 0.0
+        self._samples: Deque[float] = deque(
+            maxlen=max(16, FLAGS.metrics_hist_window))
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if v > self.vmax:
+                self.vmax = v
+            self._samples.append(v)
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self._samples)
+            out = {"count": self.count, "sum": self.total,
+                   "max": self.vmax}
+        if samples:
+            out["p50"] = _percentile(samples, 0.50)
+            out["p95"] = _percentile(samples, 0.95)
+        else:
+            out["p50"] = out["p95"] = 0.0
+        return out
+
+    def _reset(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.vmax = 0.0
+        self._samples.clear()
+
+
+class Registry:
+    """Get-or-create instrument registry; one per process (``REGISTRY``).
+
+    ``reset()`` zeroes every instrument but keeps the registrations, so
+    a snapshot taken right after a reset has the same keys (zeroed) —
+    benchmark brackets diff snapshots without key juggling."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(
+                    name, Counter(name, help, self._lock))
+        return c
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(
+                    name, Gauge(name, help, self._lock))
+        return g
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(
+                    name, Histogram(name, help, self._lock))
+        return h
+
+    def counter_values(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: c._value for k, c in self._counters.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready view of every instrument."""
+        with self._lock:
+            counters = {k: c._value for k, c in self._counters.items()}
+            gauges = {k: {"value": g._value, "max": g._max}
+                      for k, g in self._gauges.items()}
+            hists = list(self._hists.values())
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {h.name: h.summary() for h in hists},
+        }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition format (0.0.4)."""
+        lines: List[str] = []
+
+        def _name(raw: str) -> str:
+            safe = "".join(ch if (ch.isalnum() or ch == "_") else "_"
+                           for ch in raw)
+            return "spartan_" + safe
+
+        snap = self.snapshot()
+        for k in sorted(snap["counters"]):
+            n = _name(k)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {snap['counters'][k]}")
+        for k in sorted(snap["gauges"]):
+            n = _name(k)
+            g = snap["gauges"][k]
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {g['value']}")
+            lines.append(f"# TYPE {n}_max gauge")
+            lines.append(f"{n}_max {g['max']}")
+        for k in sorted(snap["histograms"]):
+            n = _name(k)
+            h = snap["histograms"][k]
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}{{quantile=\"0.5\"}} {h['p50']}")
+            lines.append(f"{n}{{quantile=\"0.95\"}} {h['p95']}")
+            lines.append(f"{n}_sum {h['sum']}")
+            lines.append(f"{n}_count {h['count']}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            insts = (list(self._counters.values())
+                     + list(self._gauges.values())
+                     + list(self._hists.values()))
+            for inst in insts:
+                inst._reset()
+
+
+REGISTRY = Registry()
+
+
+def _update_device_gauges() -> None:
+    """Record device memory gauges (high-water tracked by the Gauge)
+    where the backend exposes ``memory_stats`` (TPU does; CPU mostly
+    returns None)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            REGISTRY.gauge(
+                "device_" + key,
+                "jax device memory stat " + key).set(float(stats[key]))
+
+
+def snapshot(fmt: str = "json") -> Any:
+    """The public ``st.metrics()``: registry snapshot plus derived
+    plan-cache and device-memory views.
+
+    ``fmt="json"`` (default) returns a dict; ``fmt="prometheus"``
+    returns Prometheus text exposition format."""
+    _update_device_gauges()
+    if fmt == "prometheus":
+        return REGISTRY.prometheus()
+    if fmt != "json":
+        raise ValueError(f"unknown metrics format {fmt!r} "
+                         "(expected 'json' or 'prometheus')")
+    snap = REGISTRY.snapshot()
+    c = snap["counters"]
+    hits = c.get("plan_hits", 0)
+    misses = c.get("plan_misses", 0)
+    total = hits + misses
+    snap["plan_cache"] = {
+        "plan_hits": hits,
+        "plan_misses": misses,
+        "compiles": c.get("compiles", 0),
+        "donated_dispatches": c.get("donated_dispatches", 0),
+        "hit_rate": (hits / total) if total else None,
+    }
+    return snap

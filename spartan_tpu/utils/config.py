@@ -174,6 +174,32 @@ FLAGS.define_bool("opt_fold_slices", True,
                   "Fold slice-of-slice and slice-of-map expressions.")
 FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
 FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force().")
+# The observability layer's own switches (spartan_tpu/obs/) are defined
+# where they are consumed and documented here for discoverability:
+#   trace                (obs/trace.py, default True)  — record host spans
+#       (evaluate/sign/optimize/per-pass/tiling/compile/dispatch/fetch)
+#       into the in-memory ring for st.trace_export; <=5% overhead on a
+#       steady-state evaluate (benchmarks/obs_overhead.py gate).
+#   trace_ring           (obs/trace.py, default 4096)  — max spans kept;
+#       older spans drop when the ring wraps.
+#   metrics              (obs/metrics.py, default True) — feed the typed
+#       counter/gauge/histogram registry behind st.metrics().
+#   metrics_hist_window  (obs/metrics.py, default 2048) — samples per
+#       histogram for the p50/p95 estimates.
+FLAGS.define_bool(
+    "trace_annotations", True,
+    "Wrap every expr node's kernel body in jax.named_scope during "
+    "tracing, so device profiles (jax.profiler / Perfetto) attribute "
+    "XLA ops back to expr nodes. Trace-time-only cost; turn off to "
+    "shave cold-compile time.")
+FLAGS.define_bool(
+    "trace_loop_steps", False,
+    "Emit one host callback per st.loop iteration (jax.debug.callback "
+    "on the step index): the trace ring gains per-step 'loop_step' "
+    "spans with REAL per-iteration dispatch times instead of one "
+    "opaque fori_loop blob. Changes the lowered program (the flag is "
+    "part of the loop's structural signature), so toggling recompiles; "
+    "off by default — per-step callbacks serialize device->host.")
 FLAGS.define_str("profile_dir", "/tmp/spartan_tpu_profile",
                  "Where profiler traces are written.")
 FLAGS.define_str(

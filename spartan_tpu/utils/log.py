@@ -7,7 +7,6 @@ logging, ``Assert`` helpers heavily used by tests, timers, ``divup``).
 from __future__ import annotations
 
 import logging
-import time
 from contextlib import contextmanager
 from typing import Any, Iterator
 
@@ -90,11 +89,18 @@ class Assert:
 
 @contextmanager
 def timer_ctx(name: str = "span") -> Iterator[None]:
-    start = time.perf_counter()
+    # timing rides the sanctioned stopwatch API (lazy import: profiling
+    # imports this module for log_info) so the repo's raw-timing lint
+    # holds package-wide
+    from .profiling import stopwatch
+
+    sw = None
     try:
-        yield
+        with stopwatch() as sw:
+            yield
     finally:
-        log_info("%s: %.3f ms", name, (time.perf_counter() - start) * 1e3)
+        if sw is not None:
+            log_info("%s: %.3f ms", name, sw.elapsed * 1e3)
 
 
 class Timer:
@@ -106,11 +112,13 @@ class Timer:
 
     @contextmanager
     def measure(self) -> Iterator[None]:
-        start = time.perf_counter()
+        from .profiling import stopwatch
+
         try:
-            yield
+            with stopwatch() as sw:
+                yield
         finally:
-            self.elapsed += time.perf_counter() - start
+            self.elapsed += sw.elapsed
             self.count += 1
 
     @property

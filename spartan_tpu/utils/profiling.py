@@ -6,79 +6,152 @@ stack: ``jax.profiler`` traces (TensorBoard/Perfetto), a fetch-forced
 timing harness (``block_until_ready`` returns early on tunneled
 platforms), per-expr HLO cost from ``compiled.cost_analysis()``, and
 device memory stats.
+
+Since the observability PR this module is a thin facade over
+``spartan_tpu/obs``: counters and per-phase timers live in the typed
+metrics registry (``obs.metrics.REGISTRY``; snapshot via
+``st.metrics()``), and :func:`phase` both feeds the per-phase
+histograms AND emits a span into the trace ring buffer
+(``st.trace_export``). The PR-1 API (``count`` / ``counters`` /
+``record_phase`` / ``phase_seconds`` / ``reset_counters`` /
+``plan_cache_stats``) is kept as shims so existing tests, benchmarks
+and ``bench.py`` read identical shapes.
+
+All wall-clock measurement in the package goes through this module or
+``obs/`` (:func:`phase`, :func:`stopwatch`, ``obs.trace.span``) —
+``tools/lint_repo.py`` forbids raw ``time.perf_counter()`` timing
+anywhere else, so no timing escapes the trace.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
 
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..obs.trace import SpanCtx as _SpanCtx
+from ..obs.trace import span as _obs_span
 from .config import FLAGS
 from .log import log_info
+
+# re-exported so call sites can say ``prof.span(...)`` without importing
+# obs directly (obs.trace.span is the one span implementation)
+span = _obs_span
 
 # -- plan-cache counters and per-phase timers ----------------------------
 #
 # The evaluate() fast path (expr/base.py) is instrumented with named
 # counters (plan_hits / plan_misses / compiles / donated_dispatches /
-# evaluations) and per-phase wall-time accumulators:
+# evaluations) and per-phase wall-time histograms:
 #
 #   sign      structural signing (raw-DAG plan signature + optimized-DAG
 #             compile signature)
-#   optimize  the optimizer pass stack (plus per-pass ``pass:<name>``)
+#   optimize  the optimizer pass stack (plus per-pass ``pass:<name>``
+#             and the smart-tiling ``tiling`` sub-phase)
 #   compile   jit wrapper creation + the first call (trace + XLA compile)
 #   dispatch  steady-state execution of an already-compiled program
 #   build     Python-side assembly around dispatch: plan lookup, leaf
 #             arg gathering, DistArray result wrapping
+#   fetch     device -> host result transfer (DistArray.glom)
 #
 # Counters are process-global; tests and benchmarks bracket a region
 # with reset_counters() and read counters() after.
 
-_stats_lock = threading.Lock()
-_counters: Dict[str, int] = {}
-_phase_seconds: Dict[str, float] = {}
+_PHASE_PREFIX = "phase:"
 
 
 def count(name: str, n: int = 1) -> None:
-    with _stats_lock:
-        _counters[name] = _counters.get(name, 0) + n
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(name).inc(n)
+
+
+# phase-name -> Histogram handle; registry reset() zeroes instruments
+# in place (it never replaces them), so cached handles stay valid
+_phase_hists: Dict[str, Any] = {}
 
 
 def record_phase(name: str, seconds: float) -> None:
-    with _stats_lock:
-        _phase_seconds[name] = _phase_seconds.get(name, 0.0) + seconds
+    if _METRICS_FLAG._value:
+        h = _phase_hists.get(name)
+        if h is None:
+            h = REGISTRY.histogram(_PHASE_PREFIX + name)
+            _phase_hists[name] = h
+        h.observe(seconds)
+
+
+class _PhaseCtx(_SpanCtx):
+    """The span context of :func:`phase`: a SpanCtx (one allocation,
+    two clock reads) whose measured ``.seconds`` also feeds the
+    per-phase histogram on exit — the hot dispatch path runs several
+    of these per evaluate."""
+
+    __slots__ = ()
+
+    def __init__(self, name: str):
+        super().__init__(name, None)
+
+    def __exit__(self, et, ev, tb) -> bool:
+        r = super().__exit__(et, ev, tb)
+        record_phase(self.name, self.seconds)
+        return r
+
+
+def phase(name: str) -> _PhaseCtx:
+    """Time a named phase: a span in the trace ring (marked
+    ``error=True`` with the exception type if the block raises — the
+    elapsed time is recorded either way, so failed evaluates stay
+    visible) plus an observation in the per-phase histogram. Yields
+    the span; ``.seconds`` holds the elapsed time after exit."""
+    return _PhaseCtx(name)
+
+
+class Stopwatch:
+    """Result of :func:`stopwatch`: ``.elapsed`` seconds after exit."""
+
+    __slots__ = ("elapsed",)
+
+    def __init__(self) -> None:
+        self.elapsed = 0.0
 
 
 @contextlib.contextmanager
-def phase(name: str) -> Iterator[None]:
+def stopwatch() -> Iterator[Stopwatch]:
+    """Bare timing context for measurement harnesses (calibration,
+    benchmark loops): no span, no histogram — just ``.elapsed``. The
+    sanctioned alternative to raw ``time.perf_counter()`` pairs, which
+    the repo lint forbids outside ``obs/`` and this module."""
+    sw = Stopwatch()
     t0 = time.perf_counter()
     try:
-        yield
+        yield sw
     finally:
-        record_phase(name, time.perf_counter() - t0)
+        sw.elapsed = time.perf_counter() - t0
 
 
 def counters() -> Dict[str, int]:
     """Snapshot of the named counters (plan_hits, plan_misses, ...);
     absent counters read as 0 via .get()."""
-    with _stats_lock:
-        return dict(_counters)
+    return REGISTRY.counter_values()
 
 
 def phase_seconds() -> Dict[str, float]:
-    """Snapshot of accumulated per-phase wall time in seconds."""
-    with _stats_lock:
-        return dict(_phase_seconds)
+    """Snapshot of accumulated per-phase wall time in seconds (the
+    histograms' exact sums; p50/p95/max via ``st.metrics()``)."""
+    snap = REGISTRY.snapshot()["histograms"]
+    return {name[len(_PHASE_PREFIX):]: h["sum"]
+            for name, h in snap.items()
+            if name.startswith(_PHASE_PREFIX)}
 
 
 def reset_counters() -> None:
-    with _stats_lock:
-        _counters.clear()
-        _phase_seconds.clear()
+    """Zero every instrument in the registry (registrations survive,
+    so snapshots keep stable keys across a reset)."""
+    REGISTRY.reset()
 
 
 def plan_cache_stats() -> Dict[str, Any]:
@@ -151,9 +224,9 @@ def benchmark(fn: Callable[[], Any], iters: int = 5,
         fn()
     times = []
     for _ in range(iters):
-        t0 = time.perf_counter()
-        fn()
-        times.append(time.perf_counter() - t0)
+        with stopwatch() as sw:
+            fn()
+        times.append(sw.elapsed)
     arr = np.asarray(times)
     return {"best": float(arr.min()), "mean": float(arr.mean()),
             "std": float(arr.std()), "iters": iters}

@@ -1,7 +1,7 @@
 """tools/lint_repo.py in the tier-1 flow: the codebase must stay clean
-under its own AST lint, and the lint itself must catch the two bug
-classes it exists for (direct shard_map imports; Expr subclasses
-missing the structural hooks)."""
+under its own AST lint, and the lint itself must catch the bug classes
+it exists for (direct shard_map imports; Expr subclasses missing the
+structural hooks; raw wall-clock timing that escapes the trace)."""
 
 import ast
 import os
@@ -34,6 +34,34 @@ def test_allows_compat_shim_import(tmp_path):
     ok.write_text("from ..utils.compat import shard_map\n")
     tree = ast.parse(ok.read_text(), filename=str(ok))
     assert lint_repo.lint_shard_map_imports(str(ok), tree) == []
+
+
+def test_catches_raw_timing(tmp_path):
+    bad = tmp_path / "timed_mod.py"
+    bad.write_text(
+        "import time\n"
+        "import time as _time\n"
+        "from time import perf_counter\n"
+        "t0 = time.perf_counter()\n"
+        "t1 = _time.monotonic()\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_raw_timing(str(bad), tree)
+    assert sum(f.rule == "raw-timing" for f in findings) == 3
+    # ... and the span/phase API is named in the remedy
+    assert all("span/phase" in f.message for f in findings)
+
+
+def test_raw_timing_allowed_in_obs_and_profiling():
+    obs_path = os.path.join(lint_repo.REPO, "spartan_tpu", "obs",
+                            "trace.py")
+    prof_path = os.path.join(lint_repo.REPO, "spartan_tpu", "utils",
+                             "profiling.py")
+    tree = ast.parse("import time\nt = time.perf_counter()\n")
+    assert lint_repo.lint_raw_timing(obs_path, tree) == []
+    assert lint_repo.lint_raw_timing(prof_path, tree) == []
+    # time.time()/sleep etc. are NOT flagged anywhere (not timing)
+    other = ast.parse("import time\ntime.sleep(0.1)\nt = time.time()\n")
+    assert lint_repo.lint_raw_timing("/x/y.py", other) == []
 
 
 def test_catches_expr_subclass_missing_hooks(tmp_path):

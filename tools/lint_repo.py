@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Two repo-specific rules that generic linters cannot know:
+Three repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -13,6 +13,14 @@ Two repo-specific rules that generic linters cannot know:
    relying on the base's ``NotImplementedError`` stubs silently breaks
    the structural compile/plan caches and the optimizer rewrite
    machinery the moment such a node lands in a DAG.
+
+3. No raw wall-clock timing (``time.perf_counter()`` and friends)
+   outside ``spartan_tpu/obs/`` and ``spartan_tpu/utils/profiling.py``
+   (the observability PR): ALL in-package timing must ride the
+   span/phase/stopwatch API so every measured interval lands in the
+   trace ring and the metrics registry — a raw clock pair is
+   invisible to ``st.trace_export``/``st.metrics`` and silently
+   escapes the trace.
 
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
@@ -33,6 +41,14 @@ SHARD_MAP_SHIM = os.path.join("spartan_tpu", "utils", "compat.py")
 
 # abstract Expr layers that intentionally leave the hooks to subclasses
 _ABSTRACT_EXPRS = {"Expr"}
+
+# the only places allowed to read the raw wall clock (rule 3): the
+# observability layer itself and the profiling facade over it
+_TIMING_ALLOWED_DIRS = (os.path.join("spartan_tpu", "obs") + os.sep,)
+_TIMING_ALLOWED_FILES = {os.path.join("spartan_tpu", "utils",
+                                      "profiling.py")}
+_CLOCK_FNS = {"perf_counter", "perf_counter_ns", "monotonic",
+              "monotonic_ns"}
 
 
 class Finding:
@@ -102,6 +118,38 @@ def lint_shard_map_imports(path: str, tree: ast.AST) -> List[Finding]:
                     path, node.lineno, "shard-map-shim",
                     "attribute access on jax's shard_map: use the "
                     "spartan_tpu.utils.compat shim"))
+    return findings
+
+
+def lint_raw_timing(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 3: no raw wall-clock timing outside obs/ + the profiling
+    facade — timing that bypasses the span/phase/stopwatch API never
+    reaches the trace ring or the metrics registry."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _TIMING_ALLOWED_FILES or any(
+            rel.startswith(d) for d in _TIMING_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "raw-timing",
+            f"{what}: time all in-package work through the span/phase "
+            "API (utils/profiling.phase / .stopwatch / obs.trace.span) "
+            "so it lands in the trace ring and metrics registry"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in _CLOCK_FNS:
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("time", "_time"):
+                flag(node, f"raw {root.id}.{node.attr}() timing")
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "") == "time":
+                for a in node.names:
+                    if a.name in _CLOCK_FNS:
+                        flag(node, f"binds time.{a.name} directly")
     return findings
 
 
@@ -186,6 +234,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
                                         str(e)))
                 continue
         findings.extend(lint_shard_map_imports(path, tree))
+        findings.extend(lint_raw_timing(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
