@@ -104,6 +104,48 @@ def _fix_platform():
     return jax
 
 
+def _crash_path(stage: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"bench_crash_{stage}.json")
+
+
+def _arm_stage_forensics(stage: str) -> None:
+    """Worker-side crash forensics (call AFTER spartan_tpu imports).
+
+    Two layers, both writing ``bench_crash_<stage>.json``:
+
+    * a SIGTERM handler — the parent now SIGTERMs a timed-out stage
+      (grace period) before SIGKILL, so the child exports its partial
+      Chrome trace, ``st.metrics()`` snapshot, in-flight span tree and
+      last health word before dying: the K=1/K=512 hang class
+      (BENCH_r05.json) leaves forensics instead of nothing;
+    * the numerics dispatch watchdog (``FLAGS.dispatch_timeout_s``,
+      armed by the parent via SPARTAN_TPU_DISPATCH_TIMEOUT_S) — fires
+      from INSIDE a hung dispatch with the in-flight tree, before the
+      parent's timebox is even reached.
+    """
+    import signal
+
+    from spartan_tpu.obs import numerics
+    from spartan_tpu.utils.config import FLAGS
+
+    path = _crash_path(stage)
+    if not FLAGS.crash_dump_path:
+        FLAGS.crash_dump_path = path
+
+    def _dump(signum, frame):
+        try:
+            numerics.dump_crash(
+                path, reason=f"stage {stage}: SIGTERM (parent timebox)",
+                chrome_trace=True)
+        except Exception:
+            pass
+        finally:
+            os._exit(75)
+
+    signal.signal(signal.SIGTERM, _dump)
+
+
 def _plan_diag() -> dict:
     """Plan-cache hit/miss counters and per-phase host timers for the
     stage's JSON line + a stderr diagnostic (utils/profiling): a
@@ -137,6 +179,8 @@ def worker_dot(k: int, reps: int, precision: str | None) -> None:
     platform = jax.devices()[0].platform  # first device probe: may hang
     import spartan_tpu as st
 
+    _arm_stage_forensics(
+        f"dot_k{k}" + ("_highest" if precision == "highest" else ""))
     rng = np.random.RandomState(0)
     ea = st.from_numpy(rng.rand(N, N).astype(np.float32))
     eb = st.from_numpy(rng.rand(N, N).astype(np.float32))
@@ -179,6 +223,7 @@ def worker_kmeans(iters: int, reps: int) -> None:
     platform = jax.devices()[0].platform
     from spartan_tpu.ops import kmeans as kk
 
+    _arm_stage_forensics("kmeans")
     n, d, k = KM_N, KM_D, KM_K
     rng = np.random.RandomState(0)
     pts_np = rng.rand(n, d).astype(np.float32)
@@ -244,6 +289,8 @@ def worker_aux(reps: int) -> None:
     from spartan_tpu.examples.regression import logistic_regression
     from spartan_tpu.examples.ssvd import ssvd
 
+    _arm_stage_forensics("aux")
+
     def med(fn):
         fn()  # warmup/compile
         ts = []
@@ -296,13 +343,22 @@ def _run_stage(mode, args, timeout, env_extra=None):
     subprocess.run's TimeoutExpired path calls communicate() with no
     timeout after kill() — if the child blocks un-killably inside PJRT
     init (D-state) or forked helpers hold the pipes, the parent hangs
-    forever.  So: own session (killpg reaches helpers), SIGKILL on
-    timeout, bounded reap, and if the group still won't die, abandon it
-    and move on.  Returns (stdout, stderr, rc) with rc=None on timeout.
+    forever.  So: own session (killpg reaches helpers), SIGTERM first
+    with a bounded grace period (the worker's forensics handler exports
+    its partial Chrome trace + metrics to bench_crash_<stage>.json —
+    see _arm_stage_forensics), then SIGKILL, bounded reap, and if the
+    group still won't die, abandon it and move on.  The numerics
+    dispatch watchdog is armed at 0.8x the timebox via env so a hang
+    INSIDE one dispatch dumps its in-flight span tree before any
+    signal arrives.  Returns (stdout, stderr, rc) with rc=None on
+    timeout.
     """
     import signal
 
-    env = dict(os.environ, **(env_extra or {}))
+    env = dict(os.environ)
+    env.setdefault("SPARTAN_TPU_DISPATCH_TIMEOUT_S",
+                   str(round(0.8 * timeout, 1)))
+    env.update(env_extra or {})
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), mode]
         + [str(a) for a in args],
@@ -312,11 +368,22 @@ def _run_stage(mode, args, timeout, env_extra=None):
         out, err = proc.communicate(timeout=timeout)
         return out, err, proc.returncode
     except subprocess.TimeoutExpired:
+        out = err = ""
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+            # grace period: the forensics handler writes the crash
+            # file then _exits; a child hung un-interruptibly inside
+            # PJRT never runs it, hence the bounded wait
+            out, err = proc.communicate(timeout=20)
+            return out, err, None
+        except subprocess.TimeoutExpired:
+            pass
+        except (ProcessLookupError, PermissionError):
+            pass
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
-        out = err = ""
         try:
             # keep whatever the child managed to print — it is the only
             # diagnostic of WHY the stage had to be killed
@@ -332,6 +399,21 @@ def _parse_stage(out):
         return json.loads(line)
     except (json.JSONDecodeError, ValueError):
         return None
+
+
+def _diag(stage, reason, rc=None, err="", note=None):
+    """One structured stage diagnostic (round-5 follow-up: stage_diags
+    used to be a concatenated string the driver could not parse)."""
+    d = {"stage": stage, "reason": reason, "rc": rc}
+    tail = (err or "").strip().splitlines()[-3:]
+    if tail:
+        d["stderr_tail"] = tail
+    if note:
+        d["note"] = note
+    crash = _crash_path(stage)
+    if os.path.exists(crash):
+        d["crash_file"] = os.path.basename(crash)
+    return d
 
 
 def main() -> None:
@@ -352,15 +434,15 @@ def main() -> None:
         out, err, rc = _run_stage("--worker-dot", [k, reps, "default"],
                                   timeout)
         if rc is None:
-            tail = (err or "").strip().splitlines()[-3:]
-            diags.append(f"K={k}: killed after {timeout}s timeout"
-                         + (" | " + " | ".join(tail) if tail else ""))
+            diags.append(_diag(f"dot_k{k}",
+                               f"killed after {timeout}s timeout",
+                               err=err))
             print(f"[bench] stage K={k} timed out", file=sys.stderr)
             continue
         stage = _parse_stage(out)
         if stage is None:
-            tail = (err or "").strip().splitlines()[-3:]
-            diags.append(f"K={k}: rc={rc} " + " | ".join(tail))
+            diags.append(_diag(f"dot_k{k}", "no JSON output", rc=rc,
+                               err=err))
             print(f"[bench] stage K={k} failed rc={rc}", file=sys.stderr)
             continue
         result = stage
@@ -377,7 +459,8 @@ def main() -> None:
                                   env_extra={"JAX_PLATFORMS": "cpu"})
         result = _parse_stage(out)
         if result is None:
-            diags.append(f"cpu-fallback: rc={rc}")
+            diags.append(_diag("dot_k1", "cpu fallback failed", rc=rc,
+                               err=err))
 
     if result is not None:
         cpu_dot = _baseline("dot_4096", "gflops")
@@ -392,8 +475,10 @@ def main() -> None:
         if result.get("precision") == "f32":
             pass  # CPU fallback already measures full f32
         elif per_dot * 6 * kh * (rh + 1) > 0.8 * th:
-            diags.append(f"highest: skipped, predicted "
-                         f"{per_dot * 6 * kh * (rh + 1):.0f}s > {th}s box")
+            diags.append(_diag(
+                f"dot_k{kh}_highest", "skipped",
+                note=f"predicted {per_dot * 6 * kh * (rh + 1):.0f}s > "
+                     f"{th}s box"))
         else:
             out, err, rc = _run_stage("--worker-dot", [kh, rh, "highest"],
                                       th)
@@ -403,7 +488,8 @@ def main() -> None:
                 print(f"[bench] highest-precision stage: {hi['value']} "
                       f"GFLOPS", file=sys.stderr)
             else:
-                diags.append(f"highest: rc={rc}")
+                diags.append(_diag(f"dot_k{kh}_highest",
+                                   "no JSON output", rc=rc, err=err))
                 print("[bench] highest-precision stage failed",
                       file=sys.stderr)
 
@@ -420,7 +506,8 @@ def main() -> None:
                                          STAGE_KMEANS_TIMEOUT)
             km = _parse_stage(out)
             if km is None:
-                diags.append(f"kmeans-default: rc={km_rc}")
+                diags.append(_diag("kmeans", "default platform failed",
+                                   rc=km_rc, err=err))
         if km is None:
             # Default platform dead (or its k-means died/hung): small CPU
             # stage so the metric still lands, with an honest platform
@@ -448,7 +535,8 @@ def main() -> None:
             print(f"[bench] kmeans stage: {km['value']} iters/s",
                   file=sys.stderr)
         else:
-            diags.append(f"kmeans: rc={km_rc}")
+            diags.append(_diag("kmeans", "cpu fallback failed",
+                               rc=km_rc, err=err))
             print("[bench] kmeans stage failed", file=sys.stderr)
 
         # aux guard stage: configs 4-5 at full size, graded against the
@@ -476,10 +564,13 @@ def main() -> None:
                 print(f"[bench] aux guard: pass={result['guard_pass']}",
                       file=sys.stderr)
             else:
-                diags.append(f"aux: rc={aux_rc}")
+                diags.append(_diag("aux", "no JSON output", rc=aux_rc,
+                                   err=err))
                 print("[bench] aux stage failed", file=sys.stderr)
         if diags:
-            result["stage_diags"] = "; ".join(diags)
+            # structured list (stage/reason/rc/stderr_tail/crash_file),
+            # not the old concatenated string
+            result["stage_diags"] = diags
         print(json.dumps(result), flush=True)
         return
 
@@ -489,7 +580,9 @@ def main() -> None:
         "value": 0.0,
         "unit": "GFLOPS",
         "vs_baseline": None,
-        "error": "; ".join(diags) or "no stage produced output",
+        "error": ("; ".join(f"{d['stage']}: {d['reason']}" for d in diags)
+                  or "no stage produced output"),
+        "stage_diags": diags,
     }), flush=True)
     sys.exit(1)
 

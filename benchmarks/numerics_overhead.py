@@ -1,0 +1,150 @@
+"""Cost of the numerics sentinel (ISSUE 4 acceptance gate): with
+``FLAGS.audit_numerics`` OFF the sentinel must cost <=1% of a
+steady-state (plan-cache hit) evaluate; audit ON is reported, not
+gated.
+
+Three arms, interleaved at single-iteration granularity (base, off,
+on, base, off, on, ...) so load spikes on a shared box hit all arms
+equally:
+
+* ``base`` — the sentinel's evaluate-path hooks stubbed out (a null
+  shim swapped in for ``expr.base``'s ``numerics_mod`` binding):
+  measures the pre-sentinel dispatch path.
+* ``off`` — the real hooks with ``FLAGS.audit_numerics=False``: the
+  production default. The off-path hot cost is one watchdog flag read
+  and one empty-watchpoint-list check per dispatch; probes exist only
+  in audited traces, which this arm never compiles.
+  ``numerics_off_overhead_ratio`` = off/base - 1 is the committed
+  <=0.01 gate (benchmarks/thresholds.json).
+* ``on`` — ``FLAGS.audit_numerics=True``: every node carries a health
+  word + ``jax.debug.callback``, dispatched through the separately
+  keyed audited plan. ``audit_on_overhead_ratio`` is reported for the
+  record (device->host callbacks serialize; audit is a debugging
+  mode, not a production default).
+
+Each iteration rebuilds the k-means-step DAG and forces it through
+the plan-cache hit path (the iterative-driver shape, same as
+benchmarks/obs_overhead.py). Prints ONE JSON line.
+
+Usage: python benchmarks/numerics_overhead.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _NullWatchdogCM:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+class _NullSentinel:
+    """What expr/base.py's dispatch path would look like with no
+    sentinel compiled in: the hooks resolve to no-ops."""
+
+    _WATCHPOINTS = ()
+    _NULL = _NullWatchdogCM()
+
+    @staticmethod
+    def probe(node, val, kind="node"):
+        pass
+
+    @classmethod
+    def watchdog(cls, label, report=None):
+        return cls._NULL
+
+    @staticmethod
+    def poll_watchpoints():
+        pass
+
+    @staticmethod
+    def probe_session():
+        return _NullWatchdogCM()
+
+
+def measure(iters: int = 100, n: int = 4096, d: int = 32,
+            k: int = 16) -> dict:
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr import base as expr_base
+    from spartan_tpu.expr.base import ValExpr
+    from spartan_tpu.utils import profiling
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+
+    real = expr_base.numerics_mod
+
+    def step(cur):
+        return kmeans_step(pts, ValExpr(cur), k).evaluate()
+
+    # warm BOTH plans (plain and audited) so every measured iteration
+    # is a plan-cache hit of its own arm
+    c = step(step(c))
+    FLAGS.audit_numerics = True
+    try:
+        c = step(step(c))
+    finally:
+        FLAGS.audit_numerics = False
+
+    times = {"base": [], "off": [], "on": []}
+    try:
+        for _ in range(iters):
+            for arm in ("base", "off", "on"):
+                expr_base.numerics_mod = (_NullSentinel if arm == "base"
+                                          else real)
+                FLAGS.audit_numerics = arm == "on"
+                with profiling.stopwatch() as sw:
+                    c = step(c)
+                    c.glom()  # fetch-forced: dispatch really finished
+                times[arm].append(sw.elapsed)
+    finally:
+        expr_base.numerics_mod = real
+        FLAGS.audit_numerics = False
+
+    t_base = float(np.median(times["base"]))
+    t_off = float(np.median(times["off"]))
+    t_on = float(np.median(times["on"]))
+
+    # evidence the ON arm actually probed: health records landed
+    health_records = st.metrics()["counters"].get(
+        "numerics_health_records", 0)
+
+    return {
+        "metric": "numerics_overhead",
+        "iters": iters,
+        "shape": [n, d, k],
+        "wall_us_per_iter_base": round(t_base * 1e6, 1),
+        "wall_us_per_iter_audit_off": round(t_off * 1e6, 1),
+        "wall_us_per_iter_audit_on": round(t_on * 1e6, 1),
+        "numerics_off_overhead_ratio": round(
+            max(0.0, t_off / t_base - 1.0), 4),
+        "audit_on_overhead_ratio": round(
+            max(0.0, t_on / t_base - 1.0), 4),
+        "audit_health_records": health_records,
+    }
+
+
+def main() -> None:
+    iters = 100
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    out = measure(iters=iters, n=512 if small else 4096)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -202,6 +202,17 @@ def obs_overhead(st):
     return oo.measure(iters=30, n=512 if SMALL else 4096)
 
 
+def numerics_overhead(st):
+    """Numerics-sentinel cost (benchmarks/numerics_overhead.py):
+    audit-OFF hooks vs a stubbed-out baseline on the steady-state
+    k-means hit path; <=1% is the ISSUE-4 gate. Audit-ON is reported,
+    not gated (a debugging mode)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numerics_overhead as no
+
+    return no.measure(iters=60, n=512 if SMALL else 4096)
+
+
 def _with_metrics(fn, st):
     """Run one benchmark config and attach the ``st.metrics()``
     snapshot it produced (phase p50/p95, plan-hit ratio, counters) to
@@ -249,6 +260,9 @@ def guard_metrics(report) -> dict:
             report["verify_overhead"].get("check_vs_cold_ratio"),
         "obs_overhead_ratio":
             report["obs_overhead"].get("obs_overhead_ratio"),
+        "numerics_off_overhead_ratio":
+            report["numerics_overhead"].get(
+                "numerics_off_overhead_ratio"),
     }
 
 
@@ -271,6 +285,7 @@ def main():
         "dispatch_overhead": _with_metrics(dispatch_overhead, st),
         "verify_overhead": _with_metrics(verify_overhead, st),
         "obs_overhead": _with_metrics(obs_overhead, st),
+        "numerics_overhead": _with_metrics(numerics_overhead, st),
     }
     metrics = guard_metrics(report)
     if not SMALL:
@@ -290,9 +305,11 @@ def main():
         entry = {}
         # fixed acceptance gates (ISSUE gates, not floors derived from
         # the measurement): verify <10% of a cold evaluate, tracing
-        # <=5% of a steady-state evaluate
+        # <=5% of a steady-state evaluate, numerics sentinel (audit
+        # off) <=1% of a steady-state evaluate
         fixed = {"verify_check_vs_cold_ratio": 0.1,
-                 "obs_overhead_ratio": 0.05}
+                 "obs_overhead_ratio": 0.05,
+                 "numerics_off_overhead_ratio": 0.01}
         for k, v in metrics.items():
             if k in fixed:
                 entry[k] = {"max": fixed[k]}

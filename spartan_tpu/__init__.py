@@ -31,8 +31,9 @@ from .parallel.mesh import (build_mesh, get_mesh, initialize_distributed,
 from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import check, lint
 from . import obs
-from .obs import (ExplainReport, explain, metrics, trace_clear,
-                  trace_events, trace_export)
+from .obs import (AuditReport, ExplainReport, Watchpoint, audit, explain,
+                  loop_health, metrics, trace_clear, trace_events,
+                  trace_export, unwatch, watch)
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -45,7 +46,9 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
             "check", "lint",
             "obs", "explain", "ExplainReport", "metrics", "trace_export",
-            "trace_events", "trace_clear"]
+            "trace_events", "trace_clear",
+            "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
+            "loop_health"]
            + list(_expr_all))
 
 

@@ -230,6 +230,31 @@ class DistArray:
     def replicate(self) -> "DistArray":
         return self.retile(tiling_mod.replicated(self.ndim))
 
+    # -- data health (obs/numerics.py, the numerics sentinel) -----------
+
+    def health(self) -> dict:
+        """One-shot device-side health word: NaN/Inf counts, absmax,
+        zero fraction (a tiny jitted reduction + scalar fetch)."""
+        from ..obs import numerics
+
+        return numerics.array_health(self)
+
+    def tile_health(self) -> list:
+        """Per-tile (per device shard) health stats — names the
+        poisoned tile, not just the array."""
+        from ..obs import numerics
+
+        return numerics.tile_stats(self)
+
+    def watch(self, label: Optional[str] = None):
+        """Install a persistent numerics watchpoint on this array
+        (``st.watch(arr)``): checked now, after every ``evaluate()``
+        dispatch, and via ``.check()`` / ``.update(new_arr)``; its
+        health series feeds the metrics registry and the tracer."""
+        from ..obs import numerics
+
+        return numerics.watch(self, label)
+
     # -- per-shard execution (the foreach_tile analogue) ----------------
 
     def map_shards(self, fn: Callable[[jax.Array], jax.Array]

@@ -28,6 +28,7 @@ from ..array import distarray as da
 from ..array import tiling as tiling_mod
 from ..array.distarray import DistArray
 from ..array.tiling import Tiling
+from ..obs import numerics as numerics_mod
 from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
 from ..utils import profiling as prof
@@ -158,6 +159,11 @@ class Expr:
                     except Exception:
                         pass  # slotted/frozen exceptions: keep the original
                 raise
+            # numerics sentinel: inside an audited trace (st.audit /
+            # FLAGS.audit_numerics) attach a device-side health word +
+            # host callback to this node's value; a no-op None check
+            # otherwise, and lower() only runs on plan-cache misses
+            numerics_mod.probe(self, val)
             if self._forced_tiling is not None:
                 # smart-tiling chose this node's layout: constrain it so
                 # GSPMD materializes the planned resharding points
@@ -774,10 +780,13 @@ def _opt_flags_key() -> Tuple:
     # optimize) must be in the registry BEFORE the key is read, or the
     # very first plan key in a process can never be hit again
     _ensure_tiling_pass()
+    # audit_numerics changes the LOWERED program (health probes are
+    # compiled in), so audited and plain plans must never share a key
     return (tuple(p.name for p in _PASSES if p.enabled()),
             FLAGS.opt_fold_slices, FLAGS.placement,
             FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
-            FLAGS.tiling_operand_move_weight)
+            FLAGS.tiling_operand_move_weight,
+            bool(FLAGS.audit_numerics))
 
 
 def _arg_order(raw_leaves: List[Expr],
@@ -868,8 +877,14 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
             return ex.jitted(*args)
 
     fresh = not ex.warm
-    with prof.phase("compile" if fresh else "dispatch") as dsp:
-        out = run()
+    phase_name = "compile" if fresh else "dispatch"
+    with prof.phase(phase_name) as dsp:
+        # dispatch watchdog (obs/numerics.py): a run that exceeds
+        # FLAGS.dispatch_timeout_s dumps the in-flight span tree +
+        # plan report + last health word to a crash file; a shared
+        # no-op (one flag read) when the timeout is 0
+        with numerics_mod.watchdog(phase_name, plan.report):
+            out = run()
         if dpos:
             dsp.set(donated=sorted(dpos))
     ex.warm = True
@@ -899,6 +914,11 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
                     don["donated_dispatches"] = (
                         don.get("donated_dispatches", 0) + 1)
         expr._result = result
+    if numerics_mod._WATCHPOINTS:
+        # persistent data-health watchpoints (st.watch): re-check each
+        # after every dispatch; the empty-list read above is the whole
+        # hot-path cost when none are installed
+        numerics_mod.poll_watchpoints()
     return result
 
 
@@ -1005,15 +1025,28 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
     else:
         out_tilings = (tiling_mod.sanitize(dag.out_tiling(), dag.shape,
                                            mesh),)
+    # the audit flag is captured at plan-build time and keyed into the
+    # compile signature: an audited trace compiles health probes in,
+    # and must never alias a probe-free executable (or vice versa)
+    audit = bool(FLAGS.audit_numerics)
     key = (root_sig, tuple(t.axes for t in out_tilings),
-           tuple(sorted(mesh.shape.items())))
+           tuple(sorted(mesh.shape.items())), audit)
 
     leaf_ids = tuple(l._id for l in leaves)
     out_shardings = tuple(t.sharding(mesh) for t in out_tilings)
 
     def traced(*args: Any) -> Any:
         env: Dict[int, Any] = dict(zip(leaf_ids, args))
-        out = dag.lower(env)
+        if audit:
+            # probe session: leaves first (a poisoned input names the
+            # LEAF, not its first consumer), then every node as
+            # Expr.lower emits it — attach order is topological
+            with numerics_mod.probe_session():
+                for leaf, arg in zip(leaves, args):
+                    numerics_mod.probe(leaf, arg, kind="leaf")
+                out = dag.lower(env)
+        else:
+            out = dag.lower(env)
         # a constraint (not jit out_shardings) so GSPMD propagation can
         # negotiate ops like reverse that hard-fail on output overrides
         if is_tuple:

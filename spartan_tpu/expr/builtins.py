@@ -642,6 +642,20 @@ def _hist_expand(lo, hi):
             jnp.where(hi > lo, hi, hi + 0.5))
 
 
+def _hist_guard_range(lo, hi):
+    """np.histogram raises on a non-finite autodetected range; the
+    detection happens on device, so the check rides the numerics
+    sentinel: compiled in (and raised by ``st.audit``) only under
+    ``FLAGS.audit_numerics``, free otherwise (ADVICE r5 #2). Module
+    level on purpose — a per-call closure cell would break the
+    kernels' ``fn_key`` compile-cache stability."""
+    from ..obs import numerics as _numerics
+
+    _numerics.guard_finite(
+        "histogram.range", jnp.stack([lo, hi]),
+        "autodetected range of [%g, %g] is not finite")
+
+
 def histogram(x, bins: int = 10, range=None):
     """``np.histogram`` with STATIC bin count: (counts, edges).
 
@@ -654,15 +668,19 @@ def histogram(x, bins: int = 10, range=None):
     device) and are computed by the same formula the bucketing kernel
     uses, so exact-edge values land where the returned edges say.
 
-    Divergence from np.histogram (ADVICE round 5, finding 2): with
-    ``range=None`` the (min, max) autodetection runs ON DEVICE inside
-    the same traced program — there is no host round trip at which a
-    non-finite range could raise. Data containing NaN/±inf therefore
-    yields non-finite edges (NaN propagates through the min/max
-    reductions) and meaningless counts, where ``np.histogram`` raises
-    ``ValueError("autodetected range of [nan, nan] is not finite")``.
-    Pass an explicit finite ``range`` for data that may contain
-    non-finite values."""
+    np.histogram parity on non-finite data (ADVICE round 5, finding
+    2): with ``range=None`` the (min, max) autodetection runs ON
+    DEVICE inside the same traced program — there is no host round
+    trip at which a non-finite range could raise eagerly. The
+    autodetected range therefore carries a numerics-sentinel
+    finiteness guard (``obs/numerics.guard_finite``): evaluating
+    through ``st.audit`` raises ``ValueError("autodetected range of
+    [nan, nan] is not finite")`` exactly like ``np.histogram``, and
+    the audit report names the node that produced the NaN. The guard
+    is compiled in only under ``FLAGS.audit_numerics``, so the plain
+    dispatch-bound path costs nothing — there, non-finite data still
+    yields non-finite edges; pass an explicit finite ``range`` (which
+    validates eagerly) for data that may contain non-finite values."""
     from .map2 import map2
 
     x = as_expr(x)
@@ -708,8 +726,10 @@ def histogram(x, bins: int = 10, range=None):
     lo_e, hi_e = _rmin(x), _rmax(x)
 
     def bucket2(v, lo, hi):
-        lo, hi = _hist_expand(lo.astype(jnp.float32),
-                              hi.astype(jnp.float32))
+        lo = lo.astype(jnp.float32)
+        hi = hi.astype(jnp.float32)
+        _hist_guard_range(lo, hi)
+        lo, hi = _hist_expand(lo, hi)
         e = _hist_edges(lo, hi, bins)
         idx = jnp.searchsorted(e, v.astype(e.dtype), side="right") - 1
         return jnp.clip(idx, 0, bins - 1).astype(jnp.int32)
@@ -717,8 +737,10 @@ def histogram(x, bins: int = 10, range=None):
     counts = bincount(map_expr(bucket2, x, lo_e, hi_e), length=bins)
 
     def edges_fn(lo, hi):
-        lo, hi = _hist_expand(lo.astype(jnp.float32),
-                              hi.astype(jnp.float32))
+        lo = lo.astype(jnp.float32)
+        hi = hi.astype(jnp.float32)
+        _hist_guard_range(lo, hi)
+        lo, hi = _hist_expand(lo, hi)
         return _hist_edges(lo, hi, bins)
 
     edges = map_expr(edges_fn, lo_e, hi_e)

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
+from ..obs import numerics as obs_numerics
 from ..obs import trace as obs_trace
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
@@ -88,7 +89,9 @@ class LoopExpr(Expr):
     def __init__(self, n_expr: Expr, init: Tuple[Expr, ...],
                  carries: Tuple[CarryExpr, ...],
                  body_roots: Tuple[Expr, ...],
-                 index_expr: Optional[LoopIndexExpr]):
+                 index_expr: Optional[LoopIndexExpr],
+                 health: bool = False, early_exit: bool = False,
+                 stall_tol: float = 0.0):
         if len(init) != len(body_roots):
             raise ValueError(
                 f"loop body returned {len(body_roots)} values for "
@@ -103,6 +106,9 @@ class LoopExpr(Expr):
         self.carries = carries
         self.body_roots = body_roots
         self.index_expr = index_expr
+        self.health = bool(health or early_exit)
+        self.early_exit = bool(early_exit)
+        self.stall_tol = float(stall_tol)
         super().__init__((), body_roots[0].dtype)
 
     def children(self) -> Tuple[Expr, ...]:
@@ -112,7 +118,21 @@ class LoopExpr(Expr):
         k = len(self.init)
         return LoopExpr(new_children[0], tuple(new_children[1:1 + k]),
                         self.carries, tuple(new_children[1 + k:]),
-                        self.index_expr)
+                        self.index_expr, self.health, self.early_exit,
+                        self.stall_tol)
+
+    def _carry_norm(self, vals: Tuple[Any, ...]) -> Any:
+        # inf-norm, not L2: squaring overflows f32 for |carry| > ~2e19
+        # and would flag healthy large-magnitude carries as divergence.
+        # XLA's reduce-max does NOT reliably propagate NaN, so NaN is
+        # detected explicitly and forced into the result.
+        m = jnp.zeros((), jnp.float32)
+        nan = jnp.zeros((), jnp.bool_)
+        for v in vals:
+            vf = jnp.asarray(v, jnp.float32)
+            m = jnp.maximum(m, jnp.max(jnp.abs(vf)))
+            nan = nan | jnp.isnan(vf).any()
+        return jnp.where(nan, jnp.asarray(jnp.nan, jnp.float32), m)
 
     def _lower(self, env: Dict[int, Any]) -> Any:
         import jax
@@ -125,8 +145,9 @@ class LoopExpr(Expr):
             jnp.asarray(i.lower(env), b.dtype)
             for i, b in zip(self.init, self.body_roots))
         trace_steps = FLAGS.trace_loop_steps
+        label = f"loop#{self._id}"
 
-        def body(i: Any, carry: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        def body_core(i: Any, carry: Tuple[Any, ...]) -> Tuple[Any, ...]:
             benv = dict(env)
             if self.index_expr is not None:
                 benv[self.index_expr._id] = i
@@ -139,14 +160,63 @@ class LoopExpr(Expr):
                 # (the flag is part of _sig, so toggling recompiles)
                 jax.debug.callback(
                     functools.partial(obs_trace.record_loop_step,
-                                      f"loop#{self._id}"), i)
+                                      label), i)
             with jax.named_scope("st_loop_body"):
                 return tuple(b.lower(benv) for b in self.body_roots)
 
-        return lax.fori_loop(0, n, body, inits)
+        def health_of(i: Any, old: Tuple[Any, ...],
+                      new: Tuple[Any, ...]) -> Tuple[Any, Any]:
+            # carry norm + update norm in f32: ||new|| goes NaN/Inf the
+            # iteration the carry diverges; ||new - old|| stalls toward
+            # 0 as an iterative driver converges. One callback per step
+            # feeds the host series (obs/numerics.record_loop_health).
+            norm = self._carry_norm(new)
+            un = self._carry_norm(tuple(
+                jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32)
+                for a, b in zip(new, old)))
+            jax.debug.callback(
+                functools.partial(obs_numerics.record_loop_health,
+                                  label), i, norm, un)
+            return norm, un
+
+        if not self.early_exit:
+            def body(i: Any, carry: Tuple[Any, ...]) -> Tuple[Any, ...]:
+                new = body_core(i, carry)
+                if self.health:
+                    health_of(i, carry, new)
+                return new
+
+            return lax.fori_loop(0, n, body, inits)
+
+        # early-exit: a while_loop whose condition reads the PREVIOUS
+        # iteration's health — stop when the carry went non-finite
+        # (divergence) or, with stall_tol > 0, when the update norm
+        # dropped below it (convergence/stall). The health series
+        # records every executed step either way.
+        f32 = jnp.float32
+        stall = self.stall_tol
+
+        def w_cond(state: Tuple[Any, ...]) -> Any:
+            i, _carry, norm, un = state
+            ok = i < n
+            alive = jnp.isfinite(norm) & jnp.isfinite(un)
+            if stall > 0:
+                alive = alive & (un >= jnp.asarray(stall, f32))
+            return ok & ((i == 0) | alive)
+
+        def w_body(state: Tuple[Any, ...]) -> Tuple[Any, ...]:
+            i, carry, _norm, _un = state
+            new = body_core(i, carry)
+            norm, un = health_of(i, carry, new)
+            return (i + 1, new, norm, un)
+
+        state0 = (jnp.zeros((), jnp.int32), inits,
+                  jnp.asarray(jnp.inf, f32), jnp.asarray(jnp.inf, f32))
+        return lax.while_loop(w_cond, w_body, state0)[1]
 
     def _sig(self, ctx) -> Tuple:
-        head = (("loop", bool(FLAGS.trace_loop_steps),
+        head = (("loop", bool(FLAGS.trace_loop_steps), self.health,
+                 self.early_exit, self.stall_tol,
                  ctx.of(self.n_expr))
                 + tuple(ctx.of(i) for i in self.init))
         # bind the carries for the body traversal (see CarryExpr._sig)
@@ -191,6 +261,8 @@ class LoopItemExpr(Expr):
         label = f"loop#{self.loop._id}"
         if FLAGS.trace_loop_steps:
             obs_trace.loop_steps_begin(label)  # anchor step 0's span
+        if self.loop.health:
+            obs_numerics.loop_health_begin(label)  # fresh series
         with prof.span("loop", loop=label, n=static_n,
                        carries=len(self.loop.init)):
             siblings = getattr(self.loop, "_items", None)
@@ -227,7 +299,9 @@ class LoopItemExpr(Expr):
 
 
 def loop(n_iters: Any, body_fn: Callable, *init: Any,
-         with_index: bool = False, donate_init: bool = False):
+         with_index: bool = False, donate_init: bool = False,
+         health: bool = False, early_exit: bool = False,
+         stall_tol: float = 0.0):
     """Iterate ``body_fn`` ``n_iters`` times entirely on device.
 
     ``body_fn`` receives one lazy expr per carried value (prepended with
@@ -246,6 +320,17 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
     them anyway, so XLA may alias their HBM for the outputs). The
     donated init arrays are invalidated when the loop is forced;
     re-using them afterwards raises.
+
+    ``health``: emit a per-iteration carry-norm / update-norm health
+    series through the numerics sentinel (one ``jax.debug.callback``
+    per step; read it back via ``st.obs.numerics.loop_health()``) with
+    divergence counting in the metrics registry. ``early_exit``
+    (implies ``health``) lowers to a ``while_loop`` that stops when
+    the carry goes non-finite — a diverged k-means/SGD run ends at the
+    iteration it diverged instead of burning the remaining steps —
+    or, with ``stall_tol > 0``, when the update norm drops below the
+    tolerance (convergence). All three are part of the loop's
+    structural signature, so toggling recompiles.
     """
     init_exprs = tuple(as_expr(i) for i in init)
     if not init_exprs:
@@ -277,7 +362,8 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
                 f"{out_specs} -> {specs2}")
 
     le = LoopExpr(as_expr(n_iters), init_exprs, carries, body_roots,
-                  index_expr)
+                  index_expr, health=health, early_exit=early_exit,
+                  stall_tol=stall_tol)
     items = tuple(LoopItemExpr(le, i) for i in range(len(init_exprs)))
     le._items = items  # sibling set for one-program multi-carry forcing
     if donate_init:

@@ -20,16 +20,27 @@ FLAGS-gated cProfile dumps — SURVEY.md §5):
   order, donation slots, and ``cost_analysis()`` FLOPs for the plan —
   instant for plan-cache hits (the report is built once, on the miss
   path).
+* :mod:`numerics` — the data-health sentinel: ``st.audit(expr)``
+  (device-side per-node health words with first-bad-node attribution
+  under ``FLAGS.audit_numerics``), ``st.watch(distarray)`` persistent
+  watchpoints, ``st.loop(..., health=True)`` iteration-health series
+  with optional on-device early exit, and the dispatch watchdog
+  (``FLAGS.dispatch_timeout_s`` -> crash dump with the in-flight span
+  tree).
 
 Import discipline: ``obs`` sits BELOW the expr/array layers (only
 ``utils/config`` above it), so every subsystem can emit spans/metrics
-without import cycles; ``explain`` reaches into the expr layer lazily.
+without import cycles; ``explain`` and ``numerics`` reach into the
+expr layer lazily.
 """
 
 from . import metrics as _metrics_mod
+from . import numerics
 from . import trace as _trace_mod
 from .explain import ExplainReport, explain
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
+from .numerics import (AuditReport, Watchpoint, audit, dump_crash,
+                       loop_health, unwatch, watch, watchpoints)
 from .trace import Span, span
 
 metrics = _metrics_mod.snapshot
@@ -39,4 +50,6 @@ trace_clear = _trace_mod.clear
 
 __all__ = ["span", "Span", "trace_export", "trace_events", "trace_clear",
            "metrics", "REGISTRY", "Registry", "Counter", "Gauge",
-           "Histogram", "explain", "ExplainReport"]
+           "Histogram", "explain", "ExplainReport", "numerics",
+           "audit", "AuditReport", "watch", "unwatch", "watchpoints",
+           "Watchpoint", "loop_health", "dump_crash"]

@@ -1,0 +1,789 @@
+"""Numerics sentinel: device-side data-health observability.
+
+The tracer (PR 3) made the *plan* observable; this module makes the
+*data* observable. Under ``FLAGS.audit_numerics`` every expr node's
+lowered value gains a cheap device-side health word — NaN count, Inf
+count, absmax, zero fraction — reduced on device (per tile, then
+across the mesh by GSPMD) and delivered to the host via
+``jax.debug.callback`` tagged with the node's structural-signature
+digest, its op, and the user line that built it. On top of that one
+mechanism:
+
+* :func:`audit` — ``st.audit(expr)`` evaluates once and reports the
+  **first bad node in topological order** (children probe before
+  parents, leaves before everything), with op, build site and — for
+  leaves — per-tile stats, so a NaN born in one tile of one kernel is
+  named at its origin instead of surfacing as a garbage reduction
+  many expressions later.
+* :class:`Watchpoint` — ``st.watch(distarray)`` installs a persistent
+  watchpoint whose health series feeds the metrics registry
+  (``numerics_nan_nodes`` counter, ``numerics_absmax`` high-water
+  gauge) and the tracer (zero-duration ``health`` spans). Watchpoints
+  are re-checked after every ``evaluate()`` dispatch.
+* loop health — ``st.loop(..., health=True)`` emits a per-iteration
+  carry-norm / update-norm series through the same callback path
+  (``loop_health``), with divergence counting; ``early_exit=True``
+  additionally stops the on-device loop when the carry goes
+  non-finite or the update norm stalls below ``stall_tol``.
+* :func:`watchdog` / :func:`dump_crash` — ``evaluate()`` arms a timer
+  when ``FLAGS.dispatch_timeout_s`` > 0; a dispatch that exceeds it
+  dumps the in-flight span tree, the plan report, the last health
+  word, loop-health tails and a metrics snapshot to a crash file —
+  forensics for hung collectives that previously died silently.
+* :func:`guard_finite` — declarative trace-time guards (used by
+  ``histogram(range=None)``): under audit, a violated guard makes
+  ``st.audit`` raise ``ValueError`` with the numpy-compatible
+  message; with audit off nothing is compiled in.
+
+Cost model: the OFF path compiles **zero** callbacks — probes attach
+only inside an audit probe session, which only ``_build_plan`` opens
+when the flag is on, and the flag is part of both the plan-cache and
+compile-cache keys so audited and plain executables never collide.
+The steady-state hit path pays one flag read for the watchdog and one
+empty-list check for watchpoints (benchmarks/numerics_overhead.py
+gates the off-path at <=1%).
+
+Import discipline: sits in ``obs`` (below the expr/array layers);
+expr-layer types are reached lazily inside functions only.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.config import FLAGS
+from . import trace as trace_mod
+from .explain import key_hash
+from .metrics import METRICS_FLAG as _METRICS_FLAG
+from .metrics import REGISTRY
+
+_AUDIT_FLAG = FLAGS.define_bool(
+    "audit_numerics", False,
+    "Compile a device-side health word (NaN/Inf counts, absmax, zero "
+    "fraction) + host callback into every expr node's lowering, so "
+    "st.audit can attribute the first bad value to the node (and user "
+    "line) that produced it. Part of the plan/compile cache keys: "
+    "toggling recompiles instead of reusing a probe-free executable. "
+    "Off (the default) compiles zero callbacks in.")
+_TIMEOUT_FLAG = FLAGS.define_float(
+    "dispatch_timeout_s", 0.0,
+    "Dispatch watchdog: when > 0, an evaluate() dispatch (or first "
+    "compile+run) that exceeds this many seconds dumps the in-flight "
+    "span tree, plan report, last health word and metrics snapshot to "
+    "FLAGS.crash_dump_path — forensics for hung collectives. 0 "
+    "disarms (default).")
+_CRASH_FLAG = FLAGS.define_str(
+    "crash_dump_path", "",
+    "Where the dispatch watchdog (and dump_crash) writes its JSON "
+    "crash report; empty = spartan_tpu_crash_<pid>.json in the "
+    "system temp dir.")
+
+_lock = threading.Lock()
+_tls = threading.local()
+_watch_ids = itertools.count()
+
+# host-side state fed by the callbacks; the watchdog's timer thread
+# reads these, so everything mutates under _lock
+_last_health: Optional[Dict[str, Any]] = None
+_collectors: List["_AuditCollector"] = []
+_loop_series: Dict[str, List[Dict[str, Any]]] = {}
+_WATCHPOINTS: List["Watchpoint"] = []
+
+
+def _user_site() -> Optional[Tuple[str, int, str]]:
+    """First stack frame outside spartan_tpu (watchpoint provenance)."""
+    import sys
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(pkg):
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
+def _site_str(site: Optional[Tuple[str, int, str]]) -> Optional[str]:
+    return f"{site[0]}:{site[1]} (in {site[2]})" if site else None
+
+
+# -- the health word -----------------------------------------------------
+
+
+def _health_word(val: Any) -> Optional[Any]:
+    """Traced 5-vector [nan_count, inf_count, absmax, zero_frac, size]
+    for one lowered value — tiny reductions GSPMD computes per tile
+    and combines across the mesh. None for values health cannot be
+    defined on (tuples, empty arrays, python scalars)."""
+    import jax.numpy as jnp
+
+    if not hasattr(val, "dtype") or not hasattr(val, "shape"):
+        return None
+    size = int(np.prod(val.shape)) if len(val.shape) else 1
+    if size == 0:
+        return None
+    f32 = jnp.float32
+    x = val
+    if jnp.issubdtype(x.dtype, jnp.bool_):
+        xf = x.astype(f32)
+        nan = inf = jnp.zeros((), f32)
+        absmax = jnp.max(xf)
+        zero = jnp.mean((xf == 0).astype(f32))
+    elif jnp.issubdtype(x.dtype, jnp.inexact):
+        nan = jnp.sum(jnp.isnan(x).astype(f32))
+        inf = jnp.sum(jnp.isinf(x).astype(f32))
+        absmax = jnp.max(jnp.abs(x).astype(f32))
+        zero = jnp.mean((x == 0).astype(f32))
+    else:  # integers: NaN/Inf are impossible by construction
+        nan = inf = jnp.zeros((), f32)
+        absmax = jnp.max(jnp.abs(x).astype(f32))
+        zero = jnp.mean((x == 0).astype(f32))
+    return jnp.stack([nan, inf, absmax, zero,
+                      jnp.asarray(float(size), f32)])
+
+
+def _word_to_fields(word: Any) -> Dict[str, Any]:
+    w = np.asarray(word, dtype=np.float64).ravel()
+    return {
+        "nan_count": int(w[0]), "inf_count": int(w[1]),
+        "any_nan": bool(w[0] > 0), "any_inf": bool(w[1] > 0),
+        "absmax": float(w[2]), "zero_frac": float(w[3]),
+        "size": int(w[4]),
+    }
+
+
+# -- probe sessions (trace time) -----------------------------------------
+
+
+class _ProbeCtx:
+    """Open while an audited program is being traced: hands out
+    topological indices (children lower before parents; leaves are
+    probed first) and per-node structural-signature digests via one
+    shared, memoizing signature context."""
+
+    def __init__(self) -> None:
+        from ..expr.base import _SigCtx  # lazy: obs sits below expr
+
+        self._topo = itertools.count()
+        self._sig = _SigCtx()
+
+    def attach(self, node: Any, val: Any, kind: str) -> None:
+        import jax
+
+        word = _health_word(val)
+        if word is None:
+            return
+        topo = next(self._topo)
+        try:
+            digest = key_hash(self._sig.of(node))
+        except Exception:
+            digest = None
+        op = type(node).__name__
+        fn = getattr(node, "fn", None)
+        fname = getattr(fn, "__name__", None)
+        if fname and fname != "<lambda>":
+            op = f"{op}({fname})"
+        meta = (topo, f"{type(node).__name__}#{node._id}", op,
+                _site_str(node._site), digest, kind,
+                tuple(int(s) for s in val.shape), str(val.dtype))
+        jax.debug.callback(functools.partial(_record_health, meta),
+                           word, ordered=False)
+
+
+class _ProbeSession:
+    """Context manager installing a :class:`_ProbeCtx` for the current
+    (tracing) thread."""
+
+    __slots__ = ("prev",)
+
+    def __enter__(self) -> "_ProbeSession":
+        self.prev = getattr(_tls, "probe", None)
+        _tls.probe = _ProbeCtx()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.probe = self.prev
+
+
+def probe_session() -> _ProbeSession:
+    return _ProbeSession()
+
+
+def probing() -> bool:
+    """True while an audited program is being traced on this thread."""
+    return getattr(_tls, "probe", None) is not None
+
+
+def probe(node: Any, val: Any, kind: str = "node") -> None:
+    """Attach a health probe to one lowered value. No-op (and the only
+    cost is this None check) unless a probe session is open — i.e.
+    unless ``_build_plan`` is tracing under ``FLAGS.audit_numerics``."""
+    ctx = getattr(_tls, "probe", None)
+    if ctx is not None:
+        ctx.attach(node, val, kind)
+
+
+def guard_finite(tag: str, value: Any, message: str) -> None:
+    """Declarative finiteness guard over a traced value (builtins route
+    data-dependent validity checks through here — ADVICE r5 #2). Under
+    an audit trace a violated guard is recorded and makes ``st.audit``
+    raise ``ValueError(message % values)``; with audit off nothing is
+    compiled in, so the guard costs nothing."""
+    ctx = getattr(_tls, "probe", None)
+    if ctx is None:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    v = jnp.asarray(value, jnp.float32).ravel()
+    jax.debug.callback(functools.partial(_record_guard, tag, message),
+                       v, ordered=False)
+
+
+# -- host-side recording (callback targets) ------------------------------
+
+
+def _feed_metrics(rec: Dict[str, Any]) -> None:
+    if not _METRICS_FLAG._value:
+        return
+    REGISTRY.counter(
+        "numerics_health_records",
+        "health words received from device probes").inc()
+    if rec["any_nan"]:
+        REGISTRY.counter(
+            "numerics_nan_nodes",
+            "health words reporting at least one NaN").inc()
+    if rec["any_inf"]:
+        REGISTRY.counter(
+            "numerics_inf_nodes",
+            "health words reporting at least one Inf").inc()
+    if np.isfinite(rec["absmax"]):
+        REGISTRY.gauge(
+            "numerics_absmax",
+            "absmax high-water across probed values").set(rec["absmax"])
+
+
+def _record_health(meta: Tuple, word: Any) -> None:
+    """``jax.debug.callback`` target for node/leaf probes."""
+    global _last_health
+
+    rec = _word_to_fields(word)
+    rec.update(topo=meta[0], node=meta[1], op=meta[2], site=meta[3],
+               digest=meta[4], kind=meta[5], shape=list(meta[6]),
+               dtype=meta[7])
+    bad = rec["any_nan"] or rec["any_inf"]
+    with _lock:
+        _last_health = rec
+        for coll in _collectors:
+            coll.records.append(rec)
+    _feed_metrics(rec)
+    trace_mod.instant("health", error=bad, node=rec["node"],
+                      op=rec["op"], site=rec["site"], kind=rec["kind"],
+                      nan=rec["nan_count"], inf=rec["inf_count"],
+                      absmax=rec["absmax"], zero_frac=rec["zero_frac"])
+
+
+def _record_guard(tag: str, message: str, values: Any) -> None:
+    vals = [float(v) for v in np.asarray(values, np.float64).ravel()]
+    if all(np.isfinite(v) for v in vals):
+        return
+    rec = {"tag": tag, "message": message % tuple(vals), "values": vals}
+    with _lock:
+        for coll in _collectors:
+            coll.guards.append(rec)
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "numerics_guard_violations",
+            "finiteness guards violated (guard_finite)").inc()
+    trace_mod.instant("guard", error=True, tag=tag,
+                      message=rec["message"])
+
+
+def record_loop_health(label: str, step: Any, norm: Any,
+                       update_norm: Any) -> None:
+    """``jax.debug.callback`` target for st.loop iteration health
+    (expr/loop.py wires it when ``health=True``)."""
+    n, un = float(norm), float(update_norm)
+    finite = bool(np.isfinite(n) and np.isfinite(un))
+    rec = {"step": int(step), "norm": n, "update_norm": un,
+           "finite": finite}
+    with _lock:
+        _loop_series.setdefault(label, []).append(rec)
+    if _METRICS_FLAG._value:
+        REGISTRY.counter("numerics_loop_steps",
+                         "loop iterations with health emission").inc()
+        if not finite:
+            REGISTRY.counter(
+                "numerics_loop_divergence",
+                "loop iterations whose carry/update went "
+                "non-finite").inc()
+    trace_mod.instant("loop_health", error=not finite, loop=label,
+                      step=rec["step"], norm=n, update_norm=un)
+
+
+def loop_health_begin(label: str) -> None:
+    """Reset ``label``'s iteration-health series (a fresh forcing)."""
+    with _lock:
+        _loop_series[label] = []
+
+
+def loop_health(label: Optional[str] = None) -> Any:
+    """Iteration-health series for one loop label, or all of them."""
+    with _lock:
+        if label is not None:
+            return list(_loop_series.get(label, []))
+        return {k: list(v) for k, v in _loop_series.items()}
+
+
+def last_health() -> Optional[Dict[str, Any]]:
+    """The most recent health word received from any probe."""
+    with _lock:
+        return dict(_last_health) if _last_health else None
+
+
+# -- st.audit ------------------------------------------------------------
+
+
+class _AuditCollector:
+    __slots__ = ("records", "guards")
+
+    def __init__(self) -> None:
+        self.records: List[Dict[str, Any]] = []
+        self.guards: List[Dict[str, Any]] = []
+
+
+class AuditReport:
+    """Result of :func:`audit`: the evaluated result plus every health
+    word received, sorted topologically — ``first_bad`` is the
+    earliest node (in topological order: leaves, then children before
+    parents) whose value contained a NaN or Inf."""
+
+    def __init__(self, records: List[Dict[str, Any]], result: Any,
+                 root: str,
+                 tile_stats: Optional[List[Dict[str, Any]]] = None):
+        self.records = sorted(records, key=lambda r: r["topo"])
+        self.result = result
+        self.root = root
+        self.tile_stats = tile_stats
+        bad = [r for r in self.records if r["any_nan"] or r["any_inf"]]
+        self.first_bad: Optional[Dict[str, Any]] = bad[0] if bad else None
+        self.bad_count = len({r["node"] for r in bad})
+
+    @property
+    def ok(self) -> bool:
+        return self.first_bad is None
+
+    def nodes(self) -> List[str]:
+        """Distinct probed node labels in topological order."""
+        seen: List[str] = []
+        for r in self.records:
+            if r["node"] not in seen:
+                seen.append(r["node"])
+        return seen
+
+    def raise_if_bad(self) -> None:
+        if self.first_bad is not None:
+            fb = self.first_bad
+            raise FloatingPointError(
+                f"numerics audit: first bad node {fb['node']} "
+                f"({fb['op']}) built at {fb['site']}: "
+                f"{fb['nan_count']} NaN / {fb['inf_count']} Inf "
+                f"of {fb['size']} element(s)")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"root": self.root, "ok": self.ok,
+                "bad_nodes": self.bad_count,
+                "first_bad": self.first_bad, "records": self.records,
+                "tile_stats": self.tile_stats}
+
+    def __str__(self) -> str:
+        lines = [f"numerics audit of {self.root}: "
+                 + ("CLEAN" if self.ok
+                    else f"{self.bad_count} bad node(s)")]
+        if self.first_bad is not None:
+            fb = self.first_bad
+            lines.append(
+                f"  first bad (topo #{fb['topo']}): {fb['node']} "
+                f"[{fb['op']}] {fb['shape']} {fb['dtype']}")
+            if fb["site"]:
+                lines.append(f"    built at {fb['site']}")
+            lines.append(
+                f"    nan={fb['nan_count']} inf={fb['inf_count']} "
+                f"absmax={fb['absmax']} zero_frac="
+                f"{round(fb['zero_frac'], 4)} sig={fb['digest']}")
+            if self.tile_stats:
+                lines.append("    per-tile:")
+                for t in self.tile_stats:
+                    lines.append(
+                        f"      {t['index']}: nan={t['nan_count']} "
+                        f"inf={t['inf_count']} absmax={t['absmax']} "
+                        f"[{t['device']}]")
+        lines.append(f"  probed {len(self.nodes())} node(s), "
+                     f"{len(self.records)} health word(s)")
+        return "\n".join(lines)
+
+    __repr__ = __str__
+
+
+def _flush_effects(result: Any) -> None:
+    """Block until the dispatch finished AND its callbacks drained."""
+    import jax
+
+    arrays = result if isinstance(result, (tuple, list)) else (result,)
+    for a in arrays:
+        jarr = getattr(a, "_jax", None)
+        if jarr is not None:
+            jax.block_until_ready(jarr)
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
+
+
+def _leaf_tile_stats(root: Any, label: str
+                     ) -> Optional[List[Dict[str, Any]]]:
+    """Per-tile stats for a bad LEAF node: the leaf's DistArray is
+    still on device, so each shard can be fetched and characterized
+    independently — naming the poisoned tile, not just the array."""
+    from ..expr.base import _leaf_array
+    from ..expr.optimize import dag_nodes
+
+    for n in dag_nodes(root):
+        if f"{type(n).__name__}#{n._id}" != label:
+            continue
+        arr = _leaf_array(n)
+        if arr is not None and not arr.is_donated:
+            return tile_stats(arr)
+    return None
+
+
+def audit(expr: Any, donate: Sequence[Any] = ()) -> AuditReport:
+    """Evaluate ``expr`` once with health probes compiled in and report
+    data health per node — ``.first_bad`` is the first bad node in
+    topological order, with its op, structural digest and user build
+    site (and per-tile stats when the origin is a leaf).
+
+    The audited plan is cached under its own key (the audit flag is
+    part of the plan/compile signatures), so re-auditing the same
+    structure is a plan-cache hit. A violated :func:`guard_finite`
+    (e.g. ``histogram(range=None)`` over non-finite data) raises
+    ``ValueError`` with the numpy-compatible message."""
+    from ..expr import base
+
+    root = expr if isinstance(expr, base.Expr) else base.as_expr(expr)
+    root.invalidate()  # audit re-executes; a cached result has no probes
+    coll = _AuditCollector()
+    prev = _AUDIT_FLAG._value
+    with _lock:
+        _collectors.append(coll)
+    _AUDIT_FLAG._value = True
+    try:
+        with trace_mod.span("audit",
+                            root=f"{type(root).__name__}#{root._id}"):
+            result = base.evaluate(root, donate=donate)
+            _flush_effects(result)
+    finally:
+        _AUDIT_FLAG._value = prev
+        with _lock:
+            _collectors.remove(coll)
+    if coll.guards:
+        raise ValueError(coll.guards[0]["message"])
+    label = f"{type(root).__name__}#{root._id}"
+    report = AuditReport(coll.records, result, label)
+    if (report.first_bad is not None
+            and report.first_bad["kind"] == "leaf"):
+        report.tile_stats = _leaf_tile_stats(
+            root, report.first_bad["node"])
+    return report
+
+
+# -- watchpoints ---------------------------------------------------------
+
+
+def _as_array(x: Any) -> Any:
+    """Coerce a DistArray-or-evaluated-Expr to its DistArray (the
+    public creation API returns ValExprs)."""
+    if hasattr(x, "jax_array"):
+        return x
+    value = getattr(x, "value", None)  # ValExpr
+    if value is not None and hasattr(value, "jax_array"):
+        return value
+    result = getattr(x, "_result", None)  # any evaluated Expr
+    if result is not None and hasattr(result, "jax_array"):
+        return result
+    if hasattr(x, "evaluate"):
+        return x.evaluate()
+    raise TypeError(
+        f"expected a DistArray or an (evaluated) Expr, got "
+        f"{type(x).__name__}")
+
+
+def array_health(arr: Any) -> Dict[str, Any]:
+    """One-shot device-side health word of a DistArray (tiny jitted
+    reduction + scalar fetch)."""
+    import jax
+
+    arr = _as_array(arr)
+    if arr.size == 0:
+        return {"nan_count": 0, "inf_count": 0, "any_nan": False,
+                "any_inf": False, "absmax": 0.0, "zero_frac": 0.0,
+                "size": 0}
+    word = jax.jit(_health_word)(arr.jax_array)
+    return _word_to_fields(np.asarray(jax.device_get(word)))
+
+
+def tile_stats(arr: Any) -> List[Dict[str, Any]]:
+    """Per-tile (per device shard) health stats, host-computed from
+    the addressable shards."""
+    import jax
+
+    arr = _as_array(arr)
+    out = []
+    for sh in arr.jax_array.addressable_shards:
+        d = np.asarray(jax.device_get(sh.data))
+        df = d.astype(np.float64) if d.dtype.kind in "biu" else d
+        if d.size == 0:
+            out.append({"device": str(sh.device), "index": str(sh.index),
+                        "nan_count": 0, "inf_count": 0, "absmax": 0.0,
+                        "zero_frac": 0.0, "size": 0})
+            continue
+        out.append({
+            "device": str(sh.device), "index": str(sh.index),
+            "nan_count": int(np.isnan(df).sum()),
+            "inf_count": int(np.isinf(df).sum()),
+            "absmax": float(np.max(np.abs(df))),
+            "zero_frac": float(np.mean(df == 0)),
+            "size": int(d.size),
+        })
+    return out
+
+
+class Watchpoint:
+    """Persistent data-health watchpoint over a DistArray.
+
+    Every :meth:`check` (manual, via :meth:`update` rebinding in an
+    iterative driver, or automatic after each ``evaluate()`` dispatch)
+    appends one health record to ``series``, feeds the metrics
+    registry and emits a ``health`` trace span; ``fired`` latches True
+    the first time the array goes non-finite."""
+
+    __slots__ = ("label", "site", "series", "fired", "_arr")
+
+    def __init__(self, arr: Any, label: Optional[str] = None):
+        self.label = label or f"watch#{next(_watch_ids)}"
+        self.site = _site_str(_user_site())
+        self.series: List[Dict[str, Any]] = []
+        self.fired = False
+        self._arr = _as_array(arr)
+
+    @property
+    def array(self) -> Any:
+        return self._arr
+
+    def check(self) -> Optional[Dict[str, Any]]:
+        global _last_health
+
+        arr = self._arr
+        if arr is None or arr.is_donated:
+            return None
+        rec = array_health(arr)
+        rec.update(topo=-1, node=self.label, op="watch", site=self.site,
+                   digest=None, kind="watch",
+                   shape=list(arr.shape), dtype=str(arr.dtype))
+        bad = rec["any_nan"] or rec["any_inf"]
+        with _lock:
+            _last_health = rec
+        self.series.append(rec)
+        _feed_metrics(rec)
+        if bad and not self.fired:
+            self.fired = True
+            if _METRICS_FLAG._value:
+                REGISTRY.counter(
+                    "numerics_watchpoints_fired",
+                    "watchpoints that observed a non-finite "
+                    "value").inc()
+        trace_mod.instant("health", error=bad, node=self.label,
+                          op="watch", site=self.site, kind="watch",
+                          nan=rec["nan_count"], inf=rec["inf_count"],
+                          absmax=rec["absmax"],
+                          zero_frac=rec["zero_frac"])
+        return rec
+
+    def update(self, arr: Any) -> Optional[Dict[str, Any]]:
+        """Rebind to a new array (iterative-driver re-feed) + check."""
+        self._arr = _as_array(arr)
+        return self.check()
+
+    def tile_stats(self) -> List[Dict[str, Any]]:
+        return tile_stats(self._arr)
+
+    def close(self) -> None:
+        unwatch(self)
+
+    def __repr__(self) -> str:
+        return (f"Watchpoint({self.label!r}, checks={len(self.series)}, "
+                f"fired={self.fired})")
+
+
+def watch(arr: Any, label: Optional[str] = None) -> Watchpoint:
+    """Install a persistent watchpoint on a DistArray (``st.watch``).
+
+    Checked immediately, after every subsequent ``evaluate()``
+    dispatch, and on demand via ``.check()`` / ``.update(new_arr)``."""
+    wp = Watchpoint(arr, label)
+    with _lock:
+        _WATCHPOINTS.append(wp)
+    wp.check()
+    return wp
+
+
+def unwatch(wp: Watchpoint) -> None:
+    with _lock:
+        if wp in _WATCHPOINTS:
+            _WATCHPOINTS.remove(wp)
+
+
+def watchpoints() -> List[Watchpoint]:
+    with _lock:
+        return list(_WATCHPOINTS)
+
+
+def poll_watchpoints() -> None:
+    """Re-check every installed watchpoint (the evaluate() dispatch
+    epilogue calls this when any exist)."""
+    for wp in watchpoints():
+        try:
+            wp.check()
+        except Exception:
+            pass  # a dead/donated watched array must not fail evaluate
+
+
+# -- dispatch watchdog + crash dumps -------------------------------------
+
+
+class _NullWatchdog:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullWatchdog":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        pass
+
+
+_NULL_WD = _NullWatchdog()
+
+
+class _Watchdog:
+    """Arms a daemon timer around one dispatch; if the dispatch is
+    still running when the timer fires, dumps a crash report with the
+    in-flight span tree. Cancelled (cheaply) on normal completion."""
+
+    __slots__ = ("label", "report", "timeout", "timer", "fired")
+
+    def __init__(self, label: str, report: Optional[Dict[str, Any]],
+                 timeout: float):
+        self.label = label
+        self.report = report
+        self.timeout = timeout
+        self.timer: Optional[threading.Timer] = None
+        self.fired = False
+
+    def __enter__(self) -> "_Watchdog":
+        self.timer = threading.Timer(self.timeout, self._fire)
+        self.timer.daemon = True
+        self.timer.start()
+        return self
+
+    def _fire(self) -> None:
+        self.fired = True
+        try:
+            path = dump_crash(
+                reason=(f"dispatch watchdog: phase {self.label!r} "
+                        f"exceeded FLAGS.dispatch_timeout_s="
+                        f"{self.timeout}s"),
+                plan_report=self.report)
+            from ..utils.log import log_warn
+
+            log_warn("numerics watchdog fired (%s phase > %.3fs); "
+                     "crash dump at %s", self.label, self.timeout, path)
+        except Exception:
+            pass  # the watchdog must never take the process down
+
+    def __exit__(self, *exc: Any) -> None:
+        if self.timer is not None:
+            self.timer.cancel()
+
+
+def watchdog(label: str,
+             report: Optional[Dict[str, Any]] = None) -> Any:
+    """Watchdog context for one dispatch; a shared no-op when
+    ``FLAGS.dispatch_timeout_s`` <= 0 (one float read on the hot
+    path)."""
+    t = _TIMEOUT_FLAG._value
+    if not t or t <= 0:
+        return _NULL_WD
+    return _Watchdog(label, report, float(t))
+
+
+def _default_crash_path() -> str:
+    return os.path.join(tempfile.gettempdir(),
+                        f"spartan_tpu_crash_{os.getpid()}.json")
+
+
+def dump_crash(path: Optional[str] = None, reason: str = "",
+               plan_report: Optional[Dict[str, Any]] = None,
+               chrome_trace: bool = False,
+               extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write a JSON crash report: in-flight span tree, recent completed
+    spans, last health word, loop-health tails, watchpoint states, a
+    metrics snapshot, and (optionally) the full Chrome trace document.
+    Returns the path written."""
+    from .metrics import snapshot as metrics_snapshot
+
+    path = path or _CRASH_FLAG._value or _default_crash_path()
+    recent = []
+    for sp in trace_mod.events()[-128:]:
+        e = {"name": sp.name, "ts_us": round(sp.ts, 1),
+             "dur_us": round(sp.dur, 1), "tid": sp.tid,
+             "depth": sp.depth}
+        if sp.error:
+            e["error"] = True
+        if sp.args:
+            e["args"] = dict(sp.args)
+        recent.append(e)
+    plan = None
+    if plan_report is not None:
+        plan = {k: v for k, v in plan_report.items() if k != "arg_specs"}
+    with _lock:
+        loops = {k: v[-32:] for k, v in _loop_series.items()}
+        wps = [{"label": w.label, "fired": w.fired,
+                "checks": len(w.series),
+                "last": (w.series[-1] if w.series else None)}
+               for w in _WATCHPOINTS]
+    doc: Dict[str, Any] = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "inflight_spans": trace_mod.inflight(),
+        "recent_spans": recent,
+        "last_health": last_health(),
+        "loop_health": loops,
+        "watchpoints": wps,
+        "plan": plan,
+        "metrics": metrics_snapshot(),
+    }
+    if chrome_trace:
+        doc["chrome_trace"] = trace_mod.export()
+    if extra:
+        doc.update(extra)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    return path

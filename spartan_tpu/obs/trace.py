@@ -106,6 +106,11 @@ _lock = threading.Lock()
 _ring: Deque[Span] = deque(maxlen=max(1, FLAGS.trace_ring))
 _tls = threading.local()
 _tids: Dict[int, int] = {}  # threading ident -> small stable tid
+# tid -> stack of OPEN spans (entered, not yet exited). The numerics
+# watchdog (obs/numerics.py) reads this from its timer thread to dump
+# the in-flight span tree of a hung dispatch — the ring only ever sees
+# COMPLETED spans, which is exactly the wrong set during a hang.
+_open: Dict[int, List[Span]] = {}
 
 
 def _tid() -> int:
@@ -157,6 +162,8 @@ class SpanCtx:
         if self.init_args:
             sp.args = dict(self.init_args)
         self.sp = sp
+        with _lock:
+            _open.setdefault(sp.tid, []).append(sp)
         return sp
 
     def __exit__(self, et, ev, tb) -> bool:
@@ -173,6 +180,10 @@ class SpanCtx:
         sp.dur = (t1 - _EPOCH) * 1e6 - sp.ts
         sp.seconds = self.seconds
         _depth(-1)
+        with _lock:
+            stack = _open.get(sp.tid)
+            if stack and sp in stack:
+                stack.remove(sp)  # usually the top; raise-paths may skip
         _append(sp)
         return False
 
@@ -194,6 +205,37 @@ def events() -> List[Span]:
     """Snapshot of the ring buffer, oldest first (completion order)."""
     with _lock:
         return list(_ring)
+
+
+def inflight() -> List[Dict[str, Any]]:
+    """Snapshot of the OPEN spans, per thread, outermost first — the
+    span tree a hung dispatch is stuck inside. Each entry carries the
+    elapsed wall time so far (``elapsed_s``); the numerics watchdog
+    serializes this into the crash dump."""
+    t = now()
+    out: List[Dict[str, Any]] = []
+    with _lock:
+        for tid, stack in sorted(_open.items()):
+            for sp in stack:
+                out.append({
+                    "name": sp.name, "tid": tid, "depth": sp.depth,
+                    "ts_us": sp.ts,
+                    "elapsed_s": round(t - _EPOCH - sp.ts / 1e6, 6),
+                    "args": dict(sp.args) if sp.args else {},
+                })
+    return out
+
+
+def instant(name: str, error: bool = False, **args: Any) -> None:
+    """Record a zero-duration marker span (health words, watchpoint
+    checks). No-op when tracing is off."""
+    if not _TRACE_FLAG._value:
+        return
+    sp = Span(name, (now() - _EPOCH) * 1e6, _tid(), 0)
+    sp.error = error
+    if args:
+        sp.args = dict(args)
+    _append(sp)
 
 
 def clear() -> None:

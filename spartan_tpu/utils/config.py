@@ -186,6 +186,16 @@ FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force()."
 #       counter/gauge/histogram registry behind st.metrics().
 #   metrics_hist_window  (obs/metrics.py, default 2048) — samples per
 #       histogram for the p50/p95 estimates.
+#   audit_numerics       (obs/numerics.py, default False) — compile
+#       device-side health words + host callbacks into every node's
+#       lowering (st.audit first-bad-node attribution); part of the
+#       plan/compile cache keys; zero callbacks compiled when off
+#       (benchmarks/numerics_overhead.py <=1% off-path gate).
+#   dispatch_timeout_s   (obs/numerics.py, default 0)  — dispatch
+#       watchdog: a run exceeding this dumps the in-flight span tree +
+#       plan report + last health word to crash_dump_path.
+#   crash_dump_path      (obs/numerics.py, default "") — crash-report
+#       destination (empty = spartan_tpu_crash_<pid>.json in tmp).
 FLAGS.define_bool(
     "trace_annotations", True,
     "Wrap every expr node's kernel body in jax.named_scope during "

@@ -83,3 +83,32 @@ def test_catches_expr_subclass_missing_hooks(tmp_path):
     # the hook-complete classes (direct or inherited) are NOT flagged
     assert not any("GoodExpr" in f.message or "InheritsGood" in f.message
                    for f in findings)
+
+
+def test_catches_raw_debug_callbacks(tmp_path):
+    bad = tmp_path / "telemetry_mod.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.debug\n"
+        "from jax import debug\n"
+        "from jax.debug import callback\n"
+        "jax.debug.callback(lambda x: x, 1)\n"
+        "jax.debug.print('{}', 1)\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_debug_callbacks(str(bad), tree)
+    assert sum(f.rule == "raw-debug-callback" for f in findings) == 5
+    # ... and the sentinel API is named in the remedy
+    assert all("numerics" in f.message for f in findings)
+
+
+def test_debug_callbacks_allowed_in_obs_and_loop():
+    numerics_path = os.path.join(lint_repo.REPO, "spartan_tpu", "obs",
+                                 "numerics.py")
+    loop_path = os.path.join(lint_repo.REPO, "spartan_tpu", "expr",
+                             "loop.py")
+    tree = ast.parse("import jax\njax.debug.callback(lambda: None)\n")
+    assert lint_repo.lint_debug_callbacks(numerics_path, tree) == []
+    assert lint_repo.lint_debug_callbacks(loop_path, tree) == []
+    # unrelated .print attributes (not jax.debug) are NOT flagged
+    other = ast.parse("console.print('x')\nobj.debug.callback()\n")
+    assert lint_repo.lint_debug_callbacks("/x/y.py", other) == []

@@ -1,0 +1,362 @@
+"""Numerics sentinel (spartan_tpu/obs/numerics.py): device-side data
+health with first-bad-node attribution.
+
+Covers the ISSUE-4 acceptance surface: ``st.audit`` naming the exact
+originating node + user build site when one tile of one leaf is
+poisoned (NaN and Inf variants) across a map->reduce chain, a
+``distributed_topk`` and a ``st.loop`` k-means step; intermediate-node
+origins (Inf born in a kernel, leaves clean); per-tile stats on the
+poisoned leaf; ``DistArray`` watchpoints firing and auto-polling;
+loop iteration-health series with divergence early-exit and stall
+detection; the ``histogram(range=None)`` non-finite guard (ADVICE r5
+#2); audited-vs-plain plan-cache separation; the zero-callback OFF
+path; and the dispatch watchdog's crash dump carrying the in-flight
+span tree."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.examples.kmeans import kmeans_step
+from spartan_tpu.obs import numerics
+from spartan_tpu.utils import profiling
+from spartan_tpu.utils.config import FLAGS
+
+HERE = os.path.abspath(__file__)
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh2d):
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    st.clear_compile_cache()
+    profiling.reset_counters()
+    st.trace_clear()
+    for wp in numerics.watchpoints():
+        numerics.unwatch(wp)
+    yield
+    FLAGS.audit_numerics = False
+    FLAGS.dispatch_timeout_s = 0.0
+    FLAGS.crash_dump_path = ""
+    for wp in numerics.watchpoints():
+        numerics.unwatch(wp)
+    st.clear_compile_cache()
+    profiling.reset_counters()
+    st.trace_clear()
+
+
+def _poisoned(shape, value, where=(3, 2)):
+    """One bad element in ONE tile of a (row, col)-sharded operand."""
+    rng = np.random.RandomState(0)
+    a = rng.rand(*shape).astype(np.float32) + 0.5
+    a[where] = value
+    return a
+
+
+# -- st.audit: first-bad-node attribution --------------------------------
+
+
+def test_audit_clean_data():
+    x = st.from_numpy(np.random.RandomState(1).rand(32, 8)
+                      .astype(np.float32))
+    rep = st.audit((x * 2.0 + 1.0).sum())
+    assert rep.ok and rep.first_bad is None and rep.bad_count == 0
+    assert len(rep.records) >= 2  # leaf + at least one compute node
+    assert float(rep.result.glom()) == pytest.approx(
+        float((np.asarray(x.evaluate().glom()) * 2 + 1).sum()), rel=1e-5)
+    # leaves are probed before compute nodes (topological order)
+    kinds = [r["kind"] for r in rep.records]
+    assert kinds[0] == "leaf"
+
+
+@pytest.mark.parametrize("poison", [np.nan, np.inf])
+def test_audit_names_poisoned_leaf_in_map_reduce(poison):
+    """One tile of one leaf poisoned: the audit must name the LEAF
+    (the true origin) and its build site, not the map or the reduce
+    that inherit the bad value downstream."""
+    x = st.from_numpy(_poisoned((64, 8), poison))  # <- build site
+    y = ((x * 2.0 + 1.0).sum())
+    rep = st.audit(y)
+    assert not rep.ok
+    fb = rep.first_bad
+    assert fb["kind"] == "leaf"
+    assert fb["node"].startswith("ValExpr#")
+    assert HERE in (fb["site"] or "")
+    if np.isnan(poison):
+        assert fb["nan_count"] == 1 and not fb["any_inf"]
+    else:
+        assert fb["inf_count"] == 1 and not fb["any_nan"]
+    # downstream nodes are also bad, but attribution picks the first
+    bad = [r for r in rep.records if r["any_nan"] or r["any_inf"]]
+    assert len(bad) >= 2
+    assert all(fb["topo"] <= r["topo"] for r in bad)
+    # the report names the poisoned TILE: exactly one shard is bad
+    assert rep.tile_stats is not None
+    bad_tiles = [t for t in rep.tile_stats
+                 if t["nan_count"] or t["inf_count"]]
+    assert len(bad_tiles) == 1
+
+
+def test_audit_names_intermediate_origin():
+    """Leaves clean, Inf born inside a kernel (1/0): the first bad
+    node must be the COMPUTE node, and every leaf record clean."""
+    a = np.random.RandomState(2).rand(32, 8).astype(np.float32) + 0.5
+    a[5, 1] = 0.0
+    x = st.from_numpy(a)
+    y = (1.0 / x).sum()
+    rep = st.audit(y)
+    assert not rep.ok
+    fb = rep.first_bad
+    assert fb["kind"] == "node"
+    assert fb["any_inf"]
+    # reduce fusion may fold the 1/x map into the consuming reduce:
+    # either way the first bad node is the fused COMPUTE node
+    assert fb["node"].split("#")[0] in ("MapExpr", "ReduceExpr")
+    for r in rep.records:
+        if r["kind"] == "leaf":
+            assert not (r["any_nan"] or r["any_inf"])
+
+
+def test_audit_topk_chain():
+    x = st.from_numpy(_poisoned((64,), np.nan, where=(7,)))
+    vals, idx = st.topk(x, 4)
+    rep = st.audit(vals)
+    assert not rep.ok
+    fb = rep.first_bad
+    assert fb["kind"] == "leaf" and fb["node"].startswith("ValExpr#")
+    assert HERE in (fb["site"] or "")
+    assert fb["nan_count"] == 1
+
+
+def test_audit_loop_kmeans_step():
+    pts = st.from_numpy(_poisoned((64, 4), np.nan, where=(9, 1)))
+    c0 = st.as_expr(np.random.RandomState(3).rand(4, 4)
+                    .astype(np.float32))
+    out = st.loop(3, lambda c: kmeans_step(pts, c, 4), c0)
+    rep = st.audit(out)
+    assert not rep.ok
+    fb = rep.first_bad
+    # the poisoned points leaf is named as the origin, not the
+    # map2/segment/reduce chain inside the loop body
+    assert fb["kind"] == "leaf" and fb["node"].startswith("ValExpr#")
+    assert HERE in (fb["site"] or "")
+    assert fb["shape"] == [64, 4]
+
+
+def test_audit_report_rendering_and_digest():
+    x = st.from_numpy(_poisoned((32, 8), np.inf))
+    rep = st.audit(x.sum())
+    text = str(rep)
+    assert "first bad" in text and "built at" in text
+    assert "per-tile" in text
+    assert rep.first_bad["digest"]  # structural signature digest
+    d = rep.to_dict()
+    json.dumps(d)  # crash-dump/bench serializable
+
+
+def test_audited_and_plain_plans_never_collide():
+    """The audit flag is part of the plan/compile keys: an audited
+    evaluate must not reuse the probe-free executable (or vice
+    versa), and the OFF path must compile zero callbacks in."""
+    a = np.random.RandomState(4).rand(32, 8).astype(np.float32)
+
+    def build():
+        return (st.from_numpy(a) * 3.0).sum()
+
+    build().evaluate()  # plain plan (miss)
+    records0 = st.metrics()["counters"].get("numerics_health_records", 0)
+    assert records0 == 0  # plain path: no probes at all
+
+    rep = st.audit(build())  # audited plan (separate miss)
+    assert rep.records  # probes fired through the audited plan
+
+    mid = st.metrics()["counters"].get("numerics_health_records", 0)
+    assert mid > 0
+    build().evaluate()  # plain again: structural hit on the PLAIN plan
+    stats = profiling.plan_cache_stats()
+    assert stats["plan_hits"] >= 1
+    end = st.metrics()["counters"].get("numerics_health_records", 0)
+    assert end == mid  # the plain hit ran the callback-free executable
+
+
+def test_audit_plan_cache_hit_on_reaudit():
+    a = np.random.RandomState(5).rand(32, 8).astype(np.float32)
+    st.audit((st.from_numpy(a) * 2.0).sum())
+    profiling.reset_counters()
+    rep = st.audit((st.from_numpy(a) * 2.0).sum())
+    stats = profiling.plan_cache_stats()
+    assert stats["plan_hits"] >= 1 and stats["plan_misses"] == 0
+    assert rep.ok
+
+
+# -- watchpoints ---------------------------------------------------------
+
+
+def test_watchpoint_fires_on_distarray():
+    arr = st.from_numpy(np.ones((8, 8), np.float32)).evaluate()
+    wp = arr.watch("carry")
+    assert not wp.fired and len(wp.series) == 1
+    bad = np.ones((8, 8), np.float32)
+    bad[2, 3] = np.nan
+    wp.update(st.from_numpy(bad).evaluate())
+    assert wp.fired
+    assert wp.series[-1]["nan_count"] == 1
+    counters = st.metrics()["counters"]
+    assert counters.get("numerics_watchpoints_fired") == 1
+    assert counters.get("numerics_nan_nodes", 0) >= 1
+    # the poisoned tile is identifiable per shard
+    tiles = wp.tile_stats()
+    assert sum(1 for t in tiles if t["nan_count"]) == 1
+    # absmax high-water gauge fed by the series
+    gauges = st.metrics()["gauges"]
+    assert gauges["numerics_absmax"]["max"] >= 1.0
+
+
+def test_watchpoint_polled_after_every_evaluate():
+    arr = st.from_numpy(np.ones((8, 8), np.float32)).evaluate()
+    wp = st.watch(arr)
+    n0 = len(wp.series)
+    x = st.from_numpy(np.full((16, 4), 2.0, np.float32))
+    (x + 1.0).sum().glom()
+    (x * 2.0).sum().glom()
+    assert len(wp.series) == n0 + 2
+    st.unwatch(wp)
+    (x - 1.0).sum().glom()
+    assert len(wp.series) == n0 + 2
+
+
+# -- loop iteration health -----------------------------------------------
+
+
+def test_loop_health_series():
+    c0 = st.from_numpy(np.ones((4,), np.float32))
+    out = st.loop(5, lambda c: c * 2.0, c0, health=True)
+    out.glom()
+    series = [s for s in st.loop_health().values() if s][-1]
+    assert len(series) == 5
+    assert [s["step"] for s in series] == list(range(5))
+    assert all(s["finite"] for s in series)
+    # norms double each step (inf-norm of the carry)
+    assert series[-1]["norm"] == pytest.approx(32.0)
+
+
+def test_loop_early_exit_on_divergence():
+    c0 = st.from_numpy(np.full((4,), 1e30, np.float32))
+    out = st.loop(50, lambda c: c * 1e4, c0, early_exit=True)
+    out.glom()
+    series = [s for s in st.loop_health().values() if s][-1]
+    assert 0 < len(series) < 50  # stopped at the divergence, not at n
+    assert not series[-1]["finite"]
+    assert st.metrics()["counters"].get("numerics_loop_divergence",
+                                        0) >= 1
+
+
+def test_loop_early_exit_on_stall():
+    c0 = st.from_numpy(np.ones((4,), np.float32))
+    out = st.loop(50, lambda c: c * 1.0, c0, early_exit=True,
+                  stall_tol=1e-6)
+    res = out.glom()
+    series = [s for s in st.loop_health().values() if s][-1]
+    assert len(series) < 50
+    np.testing.assert_allclose(res, np.ones((4,), np.float32))
+
+
+def test_loop_health_is_structural():
+    """health/early_exit change the lowered program, so they must be
+    part of the loop's signature — no executable aliasing."""
+    c0 = st.from_numpy(np.ones((4,), np.float32))
+    st.loop(4, lambda c: c + 1.0, c0).glom()
+    misses0 = profiling.plan_cache_stats()["plan_misses"]
+    c1 = st.from_numpy(np.ones((4,), np.float32))
+    st.loop(4, lambda c: c + 1.0, c1, health=True).glom()
+    assert profiling.plan_cache_stats()["plan_misses"] == misses0 + 1
+
+
+# -- histogram non-finite range guard (ADVICE r5 #2) ---------------------
+
+
+def test_histogram_autorange_nonfinite_raises_under_audit():
+    x = st.from_numpy(np.array([1.0, np.nan, 3.0], np.float32))
+    counts, edges = st.histogram(x, bins=4)
+    with pytest.raises(ValueError, match="is not finite"):
+        st.audit(counts)
+
+
+def test_histogram_autorange_finite_audits_clean():
+    x = st.from_numpy(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    counts, edges = st.histogram(x, bins=4)
+    rep = st.audit(counts)
+    np.testing.assert_array_equal(np.asarray(rep.result.glom()),
+                                  [1, 1, 1, 1])
+
+
+def test_histogram_explicit_range_still_validates_eagerly():
+    x = st.from_numpy(np.array([1.0, 2.0], np.float32))
+    with pytest.raises(ValueError, match="finite"):
+        st.histogram(x, bins=4, range=(0.0, np.nan))
+
+
+# -- dispatch watchdog + crash dumps -------------------------------------
+
+
+def test_dump_crash_contains_inflight_tree(tmp_path):
+    from spartan_tpu.obs import trace as obs_trace
+
+    path = str(tmp_path / "crash.json")
+    with obs_trace.span("evaluate", root="X#1"):
+        with obs_trace.span("dispatch"):
+            numerics.dump_crash(path, reason="unit test",
+                                plan_report={"plan_key": "abc",
+                                             "arg_specs": [object()]})
+    doc = json.load(open(path))
+    names = [s["name"] for s in doc["inflight_spans"]]
+    assert names == ["evaluate", "dispatch"]  # outermost first
+    assert doc["reason"] == "unit test"
+    assert doc["plan"] == {"plan_key": "abc"}  # arg_specs stripped
+    assert "counters" in doc["metrics"]
+
+
+def test_watchdog_dumps_on_slow_dispatch(tmp_path):
+    path = str(tmp_path / "wd.json")
+    FLAGS.crash_dump_path = path
+    FLAGS.dispatch_timeout_s = 0.01
+    x = st.from_numpy(np.random.RandomState(0).rand(256, 256)
+                      .astype(np.float32))
+    # a long single-dispatch loop: far slower than the 10ms timeout
+    st.loop(2000, lambda c: st.dot(c, x) / 256.0, x).glom()
+    FLAGS.dispatch_timeout_s = 0.0
+    assert os.path.exists(path)
+    doc = json.load(open(path))
+    assert "dispatch_timeout_s" in doc["reason"]
+    inflight = [s["name"] for s in doc["inflight_spans"]]
+    assert "evaluate" in inflight
+    assert any(n in inflight for n in ("compile", "dispatch"))
+    assert doc["plan"] is not None and "plan_key" in doc["plan"]
+
+
+def test_watchdog_disarmed_by_default(tmp_path):
+    path = str(tmp_path / "never.json")
+    FLAGS.crash_dump_path = path
+    x = st.from_numpy(np.ones((16, 16), np.float32))
+    (x + 1.0).sum().glom()
+    assert not os.path.exists(path)
+
+
+# -- DistArray health helpers --------------------------------------------
+
+
+def test_distarray_health_word():
+    a = np.zeros((8, 8), np.float32)
+    a[0, 0] = np.inf
+    a[1, 1] = 7.0
+    h = st.from_numpy(a).evaluate().health()
+    assert h["any_inf"] and not h["any_nan"]
+    assert h["inf_count"] == 1
+    assert h["zero_frac"] == pytest.approx(62 / 64)
+    assert h["size"] == 64

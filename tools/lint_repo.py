@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Three repo-specific rules that generic linters cannot know:
+Four repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -21,6 +21,16 @@ Three repo-specific rules that generic linters cannot know:
    trace ring and the metrics registry — a raw clock pair is
    invisible to ``st.trace_export``/``st.metrics`` and silently
    escapes the trace.
+
+4. No raw ``jax.debug.callback`` / ``jax.debug.print`` outside
+   ``spartan_tpu/obs/`` and ``spartan_tpu/expr/loop.py`` (the
+   numerics-sentinel PR): ALL device->host telemetry must flow
+   through the sentinel API (``obs/numerics.probe`` /
+   ``guard_finite`` / ``record_loop_health``, ``obs/trace``'s
+   loop-step marks) so it is session-collected, metrics-fed and
+   trace-visible — a raw callback is invisible to ``st.audit`` and
+   the crash-dump machinery, and its host cost escapes every
+   overhead gate.
 
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
@@ -49,6 +59,14 @@ _TIMING_ALLOWED_FILES = {os.path.join("spartan_tpu", "utils",
                                       "profiling.py")}
 _CLOCK_FNS = {"perf_counter", "perf_counter_ns", "monotonic",
               "monotonic_ns"}
+
+# the only places allowed to emit raw device->host debug callbacks
+# (rule 4): the sentinel/tracer themselves, and the loop lowering that
+# wires the per-iteration marks into them
+_DEBUG_CB_ALLOWED_DIRS = (os.path.join("spartan_tpu", "obs") + os.sep,)
+_DEBUG_CB_ALLOWED_FILES = {os.path.join("spartan_tpu", "expr",
+                                        "loop.py")}
+_DEBUG_CB_FNS = {"callback", "print"}
 
 
 class Finding:
@@ -153,6 +171,47 @@ def lint_raw_timing(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_debug_callbacks(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 4: no raw jax.debug.callback / jax.debug.print outside
+    obs/ + expr/loop.py — device->host telemetry that bypasses the
+    sentinel API is invisible to st.audit, the metrics registry and
+    the crash-dump machinery."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _DEBUG_CB_ALLOWED_FILES or any(
+            rel.startswith(d) for d in _DEBUG_CB_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "raw-debug-callback",
+            f"{what}: route device->host telemetry through the "
+            "numerics sentinel (obs/numerics.probe / guard_finite / "
+            "record_loop_health) so it is audit-collected, "
+            "metrics-fed and crash-dump-visible"))
+
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr in _DEBUG_CB_FNS
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "debug"):
+            root = node.value.value
+            if isinstance(root, ast.Name) and root.id == "jax":
+                flag(node, f"raw jax.debug.{node.attr}")
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.debug"):
+                flag(node, f"import from {mod!r}")
+            elif mod == "jax" and any(
+                    a.name == "debug" for a in node.names):
+                flag(node, "binds jax.debug directly")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.debug"):
+                    flag(node, f"import {a.name}")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -235,6 +294,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
                 continue
         findings.extend(lint_shard_map_imports(path, tree))
         findings.extend(lint_raw_timing(path, tree))
+        findings.extend(lint_debug_callbacks(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
