@@ -324,6 +324,62 @@ def worker_aux(reps: int) -> None:
     }), flush=True)
 
 
+def worker_chaos(iters: int, seed: int) -> None:
+    """Opt-in chaos stage (``bench.py --chaos``): run the k-means loop
+    as a checkpointed ``st.loop`` with seeded transient faults
+    injected at real dispatch seams (spartan_tpu/resilience), and
+    report what the policy engine recovered. Prints one JSON line;
+    forensics ride the same SIGTERM/watchdog path as every other
+    stage (``_arm_stage_forensics``)."""
+    import numpy as np
+    import tempfile
+
+    jax = _fix_platform()
+    platform = jax.devices()[0].platform
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+
+    _arm_stage_forensics("chaos")
+    n, d, k = 100_000, 32, 16
+    rng = np.random.RandomState(seed)
+    pts_np = rng.rand(n, d).astype(np.float32)
+    c0 = pts_np[:k].copy()
+    points = st.from_numpy(pts_np)
+    every = max(1, iters // 4)
+
+    def run(ckpt_dir):
+        return np.asarray(st.loop(
+            iters, lambda c: kmeans_step(points, c, k),
+            st.as_expr(c0), checkpoint_every=every,
+            checkpoint_path=ckpt_dir).glom())
+
+    with tempfile.TemporaryDirectory() as tmp:
+        clean = run(os.path.join(tmp, "clean"))  # fault-free reference
+        st.FLAGS.retry_backoff_s = 0.01
+        t0 = time.perf_counter()
+        # a transient fault on the first segment dispatch and a
+        # synthetic OOM on the third (each segment is one dispatch)
+        with st.chaos("transient@0,oom@2", seed=seed):
+            faulted = run(os.path.join(tmp, "chaos"))
+        wall = time.perf_counter() - t0
+    counters = st.metrics()["counters"]
+    print(json.dumps({
+        "metric": "chaos_recovery",
+        "iters": iters,
+        "recovered_iterations": int(iters),
+        "matches_fault_free": bool(np.allclose(clean, faulted,
+                                               rtol=1e-5, atol=1e-6)),
+        "max_abs_diff": float(np.max(np.abs(clean - faulted))),
+        "faults_injected": counters.get("resilience_faults_injected", 0),
+        "retries": counters.get("resilience_retries", 0),
+        "degrades": counters.get("resilience_degrades", 0),
+        "loop_checkpoints": counters.get(
+            "resilience_loop_checkpoints", 0),
+        "seconds": round(wall, 3),
+        "platform": platform,
+    }), flush=True)
+
+
 def _benchguard():
     """Load the guard module by file path — the parent process never
     imports spartan_tpu/jax (a hung PJRT init must stay killable)."""
@@ -567,6 +623,30 @@ def main() -> None:
                 diags.append(_diag("aux", "no JSON output", rc=aux_rc,
                                    err=err))
                 print("[bench] aux stage failed", file=sys.stderr)
+        # chaos stage (opt-in with --chaos): seeded transient + OOM
+        # faults during a checkpointed k-means loop; recovery counts
+        # land in stage_diags so the driver sees what was survived
+        if "--chaos" in sys.argv and not default_dead:
+            out, err, ch_rc = _run_stage("--worker-chaos", [20, 0], 420)
+            ch = _parse_stage(out)
+            if ch is not None:
+                diags.append({
+                    "stage": "chaos", "reason": "ok", "rc": ch_rc,
+                    "recovered_iterations": ch["recovered_iterations"],
+                    "matches_fault_free": ch["matches_fault_free"],
+                    "faults_injected": ch["faults_injected"],
+                    "retries": ch["retries"],
+                    "degrades": ch["degrades"],
+                })
+                result["chaos"] = ch
+                print(f"[bench] chaos stage: {ch['faults_injected']} "
+                      f"fault(s) injected, {ch['retries']} retry(ies), "
+                      f"{ch['degrades']} degrade(s), matches="
+                      f"{ch['matches_fault_free']}", file=sys.stderr)
+            else:
+                diags.append(_diag("chaos", "no JSON output", rc=ch_rc,
+                                   err=err))
+                print("[bench] chaos stage failed", file=sys.stderr)
         if diags:
             # structured list (stage/reason/rc/stderr_tail/crash_file),
             # not the old concatenated string
@@ -595,5 +675,7 @@ if __name__ == "__main__":
         worker_kmeans(int(sys.argv[2]), int(sys.argv[3]))
     elif len(sys.argv) >= 3 and sys.argv[1] == "--worker-aux":
         worker_aux(int(sys.argv[2]))
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--worker-chaos":
+        worker_chaos(int(sys.argv[2]), int(sys.argv[3]))
     else:
         main()

@@ -1,0 +1,122 @@
+"""Cost of the resilience layer (ISSUE 5 acceptance gate): with chaos
+OFF the policy-engine wiring must cost <=1% of a steady-state
+(plan-cache hit) evaluate.
+
+Two arms, interleaved at single-iteration granularity (base, off,
+base, off, ...) so load spikes on a shared box hit both arms equally:
+
+* ``base`` — the resilience hooks stubbed out (null shims swapped in
+  for ``expr.base``'s ``faults_mod`` / ``degrade_mod`` bindings):
+  measures the pre-resilience dispatch path. The try/except frames
+  around dispatch remain in both arms (CPython try-entry is ~free;
+  only a raised exception pays).
+* ``off`` — the real hooks with no chaos plan installed: the
+  production default. The chaos-off hot cost is one module-attribute
+  read (``faults._ACTIVE is None``) per dispatch plus one
+  thread-local getattr (the degrade rung) per plan-key computation.
+  ``resilience_off_overhead_ratio`` = off/base - 1 is the committed
+  <=0.01 gate (benchmarks/thresholds.json).
+
+Each iteration rebuilds the k-means-step DAG and forces it through
+the plan-cache hit path (the iterative-driver shape, same as
+benchmarks/numerics_overhead.py). Prints ONE JSON line.
+
+Usage: python benchmarks/resilience_overhead.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _NullFaults:
+    """What expr/base.py's dispatch path looks like with no chaos
+    seam compiled in: the plan read resolves to None forever."""
+
+    _ACTIVE = None
+
+    @staticmethod
+    def fire(site):
+        pass
+
+
+class _NullDegrade:
+    """Null degrade context: the rung getattr resolves to None."""
+
+    _TLS = threading.local()
+
+
+def measure(iters: int = 100, n: int = 4096, d: int = 32,
+            k: int = 16) -> dict:
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr import base as expr_base
+    from spartan_tpu.expr.base import ValExpr
+    from spartan_tpu.utils import profiling
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+
+    real_faults = expr_base.faults_mod
+    real_degrade = expr_base.degrade_mod
+    st.chaos_clear()  # the off arm must measure the chaos-OFF path
+
+    def step(cur):
+        return kmeans_step(pts, ValExpr(cur), k).evaluate()
+
+    c = step(step(c))  # warm the plan so every iteration is a hit
+
+    times = {"base": [], "off": []}
+    try:
+        for _ in range(iters):
+            for arm in ("base", "off"):
+                null = arm == "base"
+                expr_base.faults_mod = _NullFaults if null else real_faults
+                expr_base.degrade_mod = (_NullDegrade if null
+                                         else real_degrade)
+                with profiling.stopwatch() as sw:
+                    c = step(c)
+                    c.glom()  # fetch-forced: dispatch really finished
+                times[arm].append(sw.elapsed)
+    finally:
+        expr_base.faults_mod = real_faults
+        expr_base.degrade_mod = real_degrade
+
+    t_base = float(np.median(times["base"]))
+    t_off = float(np.median(times["off"]))
+
+    snap = st.metrics()["counters"]
+    return {
+        "metric": "resilience_overhead",
+        "iters": iters,
+        "shape": [n, d, k],
+        "wall_us_per_iter_base": round(t_base * 1e6, 1),
+        "wall_us_per_iter_chaos_off": round(t_off * 1e6, 1),
+        "resilience_off_overhead_ratio": round(
+            max(0.0, t_off / t_base - 1.0), 4),
+        # evidence the off arm really took the resilience-wired path
+        # without injecting or retrying anything
+        "faults_injected": snap.get("resilience_faults_injected", 0),
+        "retries": snap.get("resilience_retries", 0),
+    }
+
+
+def main() -> None:
+    iters = 100
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    out = measure(iters=iters, n=512 if small else 4096)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

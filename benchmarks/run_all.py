@@ -213,6 +213,18 @@ def numerics_overhead(st):
     return no.measure(iters=60, n=512 if SMALL else 4096)
 
 
+def resilience_overhead(st):
+    """Resilience-layer cost (benchmarks/resilience_overhead.py):
+    chaos-OFF policy-engine wiring vs a stubbed-out baseline on the
+    steady-state k-means hit path; <=1% is the ISSUE-5 gate (one
+    module-attribute read per dispatch + one thread-local getattr per
+    plan-key computation)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import resilience_overhead as ro
+
+    return ro.measure(iters=60, n=512 if SMALL else 4096)
+
+
 def _with_metrics(fn, st):
     """Run one benchmark config and attach the ``st.metrics()``
     snapshot it produced (phase p50/p95, plan-hit ratio, counters) to
@@ -263,6 +275,9 @@ def guard_metrics(report) -> dict:
         "numerics_off_overhead_ratio":
             report["numerics_overhead"].get(
                 "numerics_off_overhead_ratio"),
+        "resilience_off_overhead_ratio":
+            report["resilience_overhead"].get(
+                "resilience_off_overhead_ratio"),
     }
 
 
@@ -286,6 +301,7 @@ def main():
         "verify_overhead": _with_metrics(verify_overhead, st),
         "obs_overhead": _with_metrics(obs_overhead, st),
         "numerics_overhead": _with_metrics(numerics_overhead, st),
+        "resilience_overhead": _with_metrics(resilience_overhead, st),
     }
     metrics = guard_metrics(report)
     if not SMALL:
@@ -309,7 +325,8 @@ def main():
         # off) <=1% of a steady-state evaluate
         fixed = {"verify_check_vs_cold_ratio": 0.1,
                  "obs_overhead_ratio": 0.05,
-                 "numerics_off_overhead_ratio": 0.01}
+                 "numerics_off_overhead_ratio": 0.01,
+                 "resilience_off_overhead_ratio": 0.01}
         for k, v in metrics.items():
             if k in fixed:
                 entry[k] = {"max": fixed[k]}

@@ -34,6 +34,8 @@ from . import obs
 from .obs import (AuditReport, ExplainReport, Watchpoint, audit, explain,
                   loop_health, metrics, trace_clear, trace_events,
                   trace_export, unwatch, watch)
+from . import resilience
+from .resilience import ChaosPlan, chaos, chaos_clear
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -48,7 +50,8 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "obs", "explain", "ExplainReport", "metrics", "trace_export",
             "trace_events", "trace_clear",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
-            "loop_health"]
+            "loop_health",
+            "resilience", "chaos", "chaos_clear", "ChaosPlan"]
            + list(_expr_all))
 
 
@@ -71,6 +74,7 @@ def initialize(argv=None):
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
         # jax's own persistence floor (min_compile_time 1s) is left
         # untouched — users tune it via jax config / env themselves
+    resilience.faults.install_from_flags()  # FLAGS.fault_inject chaos
     _mesh.initialize_distributed()  # no-op unless COORDINATOR/SLURM env
     _mesh.get_mesh()
     return rest

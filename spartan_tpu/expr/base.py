@@ -31,6 +31,8 @@ from ..array.tiling import Tiling
 from ..obs import numerics as numerics_mod
 from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
+from ..resilience import degrade as degrade_mod
+from ..resilience import faults as faults_mod
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from ..utils.log import log_debug
@@ -781,12 +783,16 @@ def _opt_flags_key() -> Tuple:
     # very first plan key in a process can never be hit again
     _ensure_tiling_pass()
     # audit_numerics changes the LOWERED program (health probes are
-    # compiled in), so audited and plain plans must never share a key
+    # compiled in), so audited and plain plans must never share a key;
+    # likewise the OOM degradation rung (resilience/degrade.py) forces
+    # different tilings/passes, so degraded and normal plans are
+    # keyed apart
     return (tuple(p.name for p in _PASSES if p.enabled()),
             FLAGS.opt_fold_slices, FLAGS.placement,
             FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
             FLAGS.tiling_operand_move_weight,
-            bool(FLAGS.audit_numerics))
+            bool(FLAGS.audit_numerics),
+            getattr(degrade_mod._TLS, "rung", None))
 
 
 def _arg_order(raw_leaves: List[Expr],
@@ -884,6 +890,13 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         # plan report + last health word to a crash file; a shared
         # no-op (one flag read) when the timeout is 0
         with numerics_mod.watchdog(phase_name, plan.report):
+            # chaos seam (resilience/faults.py): an installed plan may
+            # raise a synthetic compile/OOM/transient fault or stall
+            # here — BEFORE the executable runs, so donated buffers
+            # are never half-consumed by an injected failure. One
+            # attribute read when no plan is installed.
+            if faults_mod._ACTIVE is not None:
+                faults_mod.fire(phase_name)
             out = run()
         if dpos:
             dsp.set(donated=sorted(dpos))
@@ -920,6 +933,24 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         # hot-path cost when none are installed
         numerics_mod.poll_watchpoints()
     return result
+
+
+_engine_mod = None  # lazily-bound resilience.engine (cold path only)
+
+
+def _handle_failure(exc: Exception, expr: Expr, plan: "_Plan",
+                    leaves: List[Expr], order: Tuple[int, ...],
+                    donated: List[DistArray], mesh) -> Any:
+    """Route a failed dispatch into the resilience policy engine
+    (classify -> retry / degrade / fail-fast). The engine import is
+    deferred: failures are the cold path."""
+    global _engine_mod
+    if _engine_mod is None:
+        from ..resilience import engine as _engine
+
+        _engine_mod = _engine
+    return _engine_mod.handle_failure(exc, expr, plan, leaves, order,
+                                      donated, mesh)
 
 
 def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
@@ -966,8 +997,13 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
             if plan is not None:
                 prof.count("plan_hits")
                 esp.set(cache="hit")
-                return _dispatch(expr, plan, rctx.leaves, plan.arg_order,
-                                 donated, mesh)
+                try:
+                    return _dispatch(expr, plan, rctx.leaves,
+                                     plan.arg_order, donated, mesh)
+                except Exception as e:
+                    return _handle_failure(e, expr, plan, rctx.leaves,
+                                           plan.arg_order, donated,
+                                           mesh)
             prof.count("plan_misses")
             esp.set(cache="miss")
 
@@ -989,8 +1025,12 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
 
         # this first run dispatches through the same path a hit takes,
         # with identity arg order over the OPTIMIZED leaves
-        result = _dispatch(expr, plan, leaves, plan.arg_order, donated,
-                           mesh)
+        try:
+            result = _dispatch(expr, plan, leaves, plan.arg_order,
+                               donated, mesh)
+        except Exception as e:
+            result = _handle_failure(e, expr, plan, leaves,
+                                     plan.arg_order, donated, mesh)
         dag._result = result
         return result
 
@@ -1015,6 +1055,14 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
     if dag._result is not None:
         return None, dag, None
 
+    degrade_rung = getattr(degrade_mod._TLS, "rung", None)
+    if degrade_rung in ("finer_tiling", "fusion_off"):
+        # OOM degradation (resilience/degrade.py): override the cost
+        # model's choices with the finest divisible shardings — the
+        # dag here is a private clone, and the forced markers land in
+        # the compile signature below
+        degrade_mod.force_finer(dag, mesh)
+
     with prof.phase("sign"):
         ctx = _SigCtx()
         root_sig = ctx.of(dag)
@@ -1027,10 +1075,12 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
                                            mesh),)
     # the audit flag is captured at plan-build time and keyed into the
     # compile signature: an audited trace compiles health probes in,
-    # and must never alias a probe-free executable (or vice versa)
+    # and must never alias a probe-free executable (or vice versa).
+    # The degradation rung is keyed the same way: a fusion-off or
+    # finer-tiling replan must never alias the normal executable.
     audit = bool(FLAGS.audit_numerics)
     key = (root_sig, tuple(t.axes for t in out_tilings),
-           tuple(sorted(mesh.shape.items())), audit)
+           tuple(sorted(mesh.shape.items())), audit, degrade_rung)
 
     leaf_ids = tuple(l._id for l in leaves)
     out_shardings = tuple(t.sharding(mesh) for t in out_tilings)

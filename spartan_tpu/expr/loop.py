@@ -301,7 +301,9 @@ class LoopItemExpr(Expr):
 def loop(n_iters: Any, body_fn: Callable, *init: Any,
          with_index: bool = False, donate_init: bool = False,
          health: bool = False, early_exit: bool = False,
-         stall_tol: float = 0.0):
+         stall_tol: float = 0.0, checkpoint_every: int = 0,
+         checkpoint_path: Optional[str] = None,
+         resume: Optional[str] = None):
     """Iterate ``body_fn`` ``n_iters`` times entirely on device.
 
     ``body_fn`` receives one lazy expr per carried value (prepended with
@@ -331,7 +333,30 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
     or, with ``stall_tol > 0``, when the update norm drops below the
     tolerance (convergence). All three are part of the loop's
     structural signature, so toggling recompiles.
+
+    ``checkpoint_every`` / ``checkpoint_path`` / ``resume``
+    (resilience/loop_ckpt.py): split the loop into segments of
+    ``checkpoint_every`` iterations, atomically snapshotting the
+    carries to ``checkpoint_path`` after each segment and restoring
+    the last good snapshot if a segment fails; ``resume=path`` picks
+    up a killed run at its last snapshot and reproduces the
+    uninterrupted final carry bit-for-bit. Checkpointed loops run
+    eagerly (segments must dispatch to snapshot between them) and
+    return the final carries as ``Val`` exprs — ``.glom()`` /
+    ``.evaluate()`` work unchanged. Composes with ``health`` /
+    ``early_exit`` (an early-exited segment ends the loop at that
+    snapshot) and with the in-evaluate retry/degradation policy
+    engine (docs/RESILIENCE.md).
     """
+    if checkpoint_every or resume is not None:
+        from ..resilience.loop_ckpt import checkpointed_loop
+
+        return checkpointed_loop(
+            n_iters, body_fn, init, with_index=with_index,
+            donate_init=donate_init, health=health,
+            early_exit=early_exit, stall_tol=stall_tol,
+            every=int(checkpoint_every or 0), path=checkpoint_path,
+            resume=resume)
     init_exprs = tuple(as_expr(i) for i in init)
     if not init_exprs:
         raise ValueError("loop needs at least one carried value")

@@ -276,6 +276,19 @@ class ExplainReport:
             lines.append(
                 f"  donation: args {don['last_donated_args']} donated "
                 f"({don['donated_dispatches']} donated dispatch(es))")
+        res = d.get("resilience")
+        if res:
+            line = f"  resilience: retries={res.get('retries', 0)}"
+            if res.get("rung"):
+                line += f", degraded rung={res['rung']}"
+            if res.get("restores"):
+                line += f", loop restores={res['restores']}"
+            if res.get("resumed_from") is not None:
+                line += f", resumed from iteration {res['resumed_from']}"
+            lines.append(line)
+            for fault in (res.get("faults") or [])[:3]:
+                lines.append(f"    fault [{fault['class']}]: "
+                             f"{fault['error']}")
         ca = d.get("cost_analysis")
         if ca:
             lines.append(
@@ -306,6 +319,10 @@ def explain(expr: Any, cost: bool = True) -> ExplainReport:
             "cache": "evaluated", "plan_key": None, "passes": [],
             "tilings": [], "reshard_edges": [], "leaves": None,
             "arg_order": None, "donation": {}, "cost_analysis": None,
+            # the resilience record (retries taken, OOM rung reached,
+            # loop restores/resume) survives on the expr even after
+            # its plan report is unreachable through the cache
+            "resilience": getattr(root, "_resilience", None),
             "note": "expr already carries a result; nothing to plan",
         })
 

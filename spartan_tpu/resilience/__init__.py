@@ -1,0 +1,49 @@
+"""Resilient execution: fault injection, classified retry, OOM
+degradation, loop checkpoint/resume.
+
+The reference Spartan survived worker death by recomputing lost tiles
+from expression lineage (SURVEY.md §5). In the single-controller XLA
+runtime a failure is the exception the blocking dispatch raises — so
+resilience is a *policy* problem, not a bookkeeping one, and this
+package makes every failure a tested, observable code path:
+
+* :mod:`faults` — deterministic, seeded fault injection (``st.chaos``
+  / ``FLAGS.fault_inject``) at the real seams: compile error,
+  dispatch RESOURCE_EXHAUSTED, transient XlaRuntimeError, slow
+  dispatch (trips the PR-4 watchdog), checkpoint IO error. Every
+  recovery path below is exercisable in CPU CI.
+* :mod:`classify` — the error decision table: transient / oom / io /
+  deterministic, by exception type and XLA/gRPC status pattern.
+* :mod:`engine` — the retry policy engine inside ``evaluate()``:
+  transient → exponential backoff with jitter under a per-plan retry
+  budget; deterministic → fail fast with the plan report attached;
+  oom → the degradation ladder. Every attempt emits ``resilience_*``
+  metrics and ``retry``/``degrade`` trace spans, and terminal
+  failures feed ``dump_crash()`` forensics.
+* :mod:`degrade` — the OOM ladder: re-plan at the finest divisible
+  tiling → fusion passes off → chunked row-block evaluation, each
+  rung keyed into the plan/compile caches and recorded on the plan
+  report (``st.explain`` names the rung taken).
+* :mod:`loop_ckpt` — ``st.loop(..., checkpoint_every=N,
+  checkpoint_path=p, resume=p)``: atomic periodic carry snapshots,
+  restore-on-failure, cross-process resume reproducing the
+  uninterrupted run bit-for-bit.
+
+See docs/RESILIENCE.md for the failure model and a chaos-testing
+how-to. Import discipline: this package sits below the expr layer
+(config/obs only at import time); expr types are reached lazily.
+"""
+
+from . import classify, degrade, engine, faults, loop_ckpt
+from .classify import DETERMINISTIC, IO, OOM, TRANSIENT, classify as classify_error
+from .faults import (ChaosPlan, InjectedCheckpointError,
+                     InjectedCompileError, InjectedOOMError,
+                     InjectedTransientError, chaos, chaos_clear)
+
+__all__ = [
+    "chaos", "chaos_clear", "ChaosPlan", "classify_error",
+    "TRANSIENT", "OOM", "IO", "DETERMINISTIC",
+    "InjectedTransientError", "InjectedOOMError",
+    "InjectedCompileError", "InjectedCheckpointError",
+    "classify", "degrade", "engine", "faults", "loop_ckpt",
+]

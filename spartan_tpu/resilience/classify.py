@@ -1,0 +1,87 @@
+"""Error classifier: turn an exception into a recovery decision.
+
+The blind ``evaluate_with_recovery`` wrapper retried *any*
+``RuntimeError`` — including deterministic compile errors it would
+re-raise forever-ish — and did nothing smart about OOM. This module is
+the decision table the policy engine (:mod:`resilience.engine`)
+executes:
+
+==============  ======================================  ================
+class           what it covers                          policy
+==============  ======================================  ================
+``transient``   device loss / preemption / UNAVAILABLE  retry with
+                / DEADLINE_EXCEEDED / ABORTED /         exponential
+                CANCELLED / socket + connection drops   backoff + jitter
+``oom``         RESOURCE_EXHAUSTED / out-of-memory      degradation
+                allocation failures                     ladder (replan
+                                                        finer -> fusion
+                                                        off -> chunked)
+``io``          OSError from the checkpoint IO layer    retry (driver
+                                                        level)
+``deterministic`` everything else: user errors          fail fast with
+                (ValueError/TypeError/ExprError),       the plan report
+                INVALID_ARGUMENT compile errors, ...    attached
+==============  ======================================  ================
+
+Classification is by exception TYPE first (OSError -> ``io``) and by
+gRPC/XLA status-message pattern second — jax's device-side faults
+(``XlaRuntimeError``) all subclass ``RuntimeError`` and are only
+distinguishable by their status prefix. Injected faults
+(:mod:`resilience.faults`) carry the same message patterns on purpose,
+so the chaos path and the real-fault path exercise the same table.
+"""
+
+from __future__ import annotations
+
+TRANSIENT = "transient"
+OOM = "oom"
+IO = "io"
+DETERMINISTIC = "deterministic"
+
+# RESOURCE_EXHAUSTED is the XLA/gRPC status for allocation failure;
+# the free-text forms cover PJRT allocator messages.
+_OOM_MARKERS = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "out-of-memory", "failed to allocate", "allocation failure",
+)
+
+# Transient runtime/infrastructure statuses: worth retrying because a
+# re-dispatch can succeed once the condition clears. INTERNAL is
+# deliberately absent — XLA INTERNAL errors are usually deterministic
+# compiler/runtime bugs that a retry only repeats.
+_TRANSIENT_MARKERS = (
+    "unavailable", "deadline_exceeded", "deadline exceeded", "aborted",
+    "cancelled", "device lost", "device loss", "preempt",
+    "connection reset", "connection refused", "socket closed",
+    "heartbeat", "network", "too many pings",
+)
+
+
+def _match(text: str, markers: tuple) -> bool:
+    return any(m in text for m in markers)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to one of the four recovery classes."""
+    kind = getattr(exc, "fault_kind", None)
+    if kind is not None:  # injected faults label themselves, but their
+        # messages ALSO match the patterns below; the attribute is just
+        # the fast path (and covers hypothetical pattern drift)
+        return {"transient": TRANSIENT, "oom": OOM, "io": IO,
+                "compile": DETERMINISTIC}.get(kind, DETERMINISTIC)
+    if isinstance(exc, OSError):
+        return IO
+    text = str(exc).lower()
+    if isinstance(exc, (MemoryError,)):
+        return OOM
+    if isinstance(exc, RuntimeError):
+        if _match(text, _OOM_MARKERS):
+            return OOM
+        if _match(text, _TRANSIENT_MARKERS):
+            return TRANSIENT
+    return DETERMINISTIC
+
+
+def retryable(exc: BaseException) -> bool:
+    """True when a plain retry is worth attempting (transient / io)."""
+    return classify(exc) in (TRANSIENT, IO)

@@ -1,0 +1,287 @@
+"""OOM degradation ladder: trade speed for memory instead of dying.
+
+GSPMD-style single-program execution means one chip's
+``RESOURCE_EXHAUSTED`` kills the whole step — yet the fix, re-planning
+the same DAG at a finer tiling (smaller per-chip shards), is exactly
+what the tiling cost model already knows how to do, and redistribution
+cost is plannable (PAPERS.md, memory-efficient array redistribution).
+On an OOM-classified dispatch failure the policy engine walks this
+ladder, rung by rung, until one fits:
+
+1. ``finer_tiling`` — re-plan the (cloned) DAG forcing the
+   finest divisible sharding the mesh can express on every interior
+   node and the outputs: per-chip shard bytes drop by the added
+   parallelism (halved/quartered tile extents).
+2. ``fusion_off`` — additionally disable the map/reduce fusion passes:
+   smaller fused kernels bound XLA's per-fusion live range (keeps the
+   finer tiling of rung 1).
+3. ``chunked`` — last resort: evaluate the root in row blocks
+   (slices along axis 0), fetching each block to host and
+   re-assembling — peak device memory is one block's worth. Only
+   applies to array-shaped single roots.
+
+Every rung evaluates under a *degrade context* whose rung name is
+keyed into BOTH the plan-cache key (via ``_opt_flags_key``) and the
+compile-cache key, so degraded and normal executables never collide;
+the rung taken is recorded on the plan report (``st.explain``) and in
+the ``resilience_degrade_<rung>`` counters.
+
+The re-plan works on a CLONE of the raw DAG (fresh interior nodes,
+shared leaves, cached frontiers collapsed to Val leaves), so forcing
+tilings never mutates the user's expression objects or pollutes the
+normal plan's signature.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
+from ..utils.log import log_warn
+
+FLAGS.define_bool(
+    "oom_degrade", True,
+    "On a RESOURCE_EXHAUSTED dispatch failure, walk the degradation "
+    "ladder (replan at finer tiling -> fusion passes off -> chunked "
+    "row-block evaluation) instead of raising. Each rung is keyed "
+    "into the plan/compile caches so degraded and normal executables "
+    "never collide.")
+FLAGS.define_int(
+    "degrade_chunks", 0,
+    "Row-block count for the 'chunked' ladder rung (0 = one block "
+    "per mesh device, min 2).")
+
+RUNGS = ("finer_tiling", "fusion_off", "chunked")
+
+# Thread-local degrade context. expr/base reads ``_TLS.rung`` on every
+# evaluate (one getattr) to key plans; only the ladder ever sets it.
+_TLS = threading.local()
+
+
+def active_rung() -> Optional[str]:
+    return getattr(_TLS, "rung", None)
+
+
+class _RungCtx:
+    """Set/restore the degrade rung (and, for ``fusion_off``+, the
+    fusion pass flags) around one degraded re-plan."""
+
+    __slots__ = ("rung", "_prev", "_flags")
+
+    def __init__(self, rung: str):
+        self.rung = rung
+        self._prev = None
+        self._flags = None
+
+    def __enter__(self) -> "_RungCtx":
+        self._prev = getattr(_TLS, "rung", None)
+        _TLS.rung = self.rung
+        if self.rung in ("fusion_off", "chunked"):
+            self._flags = (FLAGS.opt_map_fusion, FLAGS.opt_reduce_fusion)
+            FLAGS.opt_map_fusion = False
+            FLAGS.opt_reduce_fusion = False
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _TLS.rung = self._prev
+        if self._flags is not None:
+            FLAGS.opt_map_fusion, FLAGS.opt_reduce_fusion = self._flags
+
+
+class NotApplicable(Exception):
+    """A rung that cannot apply to this root (e.g. chunking a scalar)."""
+
+
+# -- DAG cloning ---------------------------------------------------------
+
+
+def clone_for_replan(root: Any) -> Any:
+    """Deep-copy the interior of a DAG (fresh ``_id``s, no forced
+    tilings, no cached results) while SHARING leaves, and collapsing
+    any interior node that already carries a result into a Val leaf —
+    the same frontier the plan signature sees. Mutating the clone
+    (``force_finer``) can then never touch user-held expression
+    objects."""
+    from ..array.distarray import DistArray
+    from ..expr.base import ValExpr
+
+    memo = {}
+
+    def go(n):
+        out = memo.get(n._id)
+        if out is not None:
+            return out
+        if (n._result is not None and not isinstance(n, ValExpr)
+                and isinstance(n._result, DistArray)):
+            out = ValExpr(n._result)
+        else:
+            kids = n.children()
+            if not kids:
+                out = n  # leaves (Val/Scalar/Carry) are shared
+            else:
+                out = n.replace_children(tuple(go(k) for k in kids))
+        memo[n._id] = out
+        return out
+
+    return go(root)
+
+
+# -- rung 1/2: forced finer tiling --------------------------------------
+
+
+def force_finer(dag: Any, mesh) -> int:
+    """Force the finest divisible candidate tiling on every interior
+    node of ``dag`` (call on a clone only). Returns how many nodes
+    were re-forced. Runs inside ``_build_plan`` between the optimizer
+    and the signature, so the forced markers land in the compile key."""
+    from ..array import tiling as tiling_mod
+    from ..expr import tiling_cost
+    from ..expr.base import ScalarExpr, ValExpr
+    from ..expr.optimize import dag_nodes
+
+    forced = 0
+    for n in dag_nodes(dag):
+        if isinstance(n, (ValExpr, ScalarExpr)) or not n.children():
+            continue
+        if n.ndim == 0:
+            continue
+        cands = tiling_cost.candidates(n, mesh)
+        if not cands:
+            continue
+        best = max(cands, key=lambda t: tiling_cost._parallelism(t, mesh))
+        try:
+            cur = tiling_mod.sanitize(n.out_tiling(), n.shape, mesh)
+        except Exception:
+            cur = tiling_mod.replicated(n.ndim)
+        if (tiling_cost._parallelism(best, mesh)
+                > tiling_cost._parallelism(cur, mesh)):
+            n._forced_tiling = best
+            forced += 1
+    return forced
+
+
+def _replan_evaluate(expr: Any, donated: List[Any], rung: str) -> Any:
+    """Clone the raw DAG and evaluate it under the degrade context;
+    the plan caches key on the rung, so repeated degradations of the
+    same structure are plan-cache hits."""
+    from ..expr import base
+
+    clone = clone_for_replan(expr)
+    with _RungCtx(rung):
+        return base.evaluate(clone, donate=donated)
+
+
+# -- rung 3: chunked row-block evaluation -------------------------------
+
+
+def _chunk_bounds(n_rows: int, chunks: int) -> List[int]:
+    chunks = max(2, min(chunks, n_rows))
+    step = -(-n_rows // chunks)
+    bounds = list(range(0, n_rows, step)) + [n_rows]
+    return bounds
+
+
+def _chunked_evaluate(expr: Any, mesh) -> Any:
+    """Evaluate ``expr`` in row blocks: slice the root along axis 0,
+    force each block separately (peak device memory ~ one block), fetch
+    to host and re-assemble into a fresh DistArray. The spill rung —
+    slow, but it completes."""
+    import numpy as np
+
+    from ..array import distarray as da
+    from ..expr.base import TupleExpr
+    from ..parallel import mesh as mesh_mod
+
+    if isinstance(expr, TupleExpr) or expr.ndim == 0:
+        raise NotApplicable(
+            "chunked evaluation needs a single array-shaped root")
+    n_rows = int(expr.shape[0])
+    if n_rows < 2:
+        raise NotApplicable("root has fewer than 2 rows to chunk")
+    chunks = FLAGS.degrade_chunks or mesh_mod.device_count(mesh)
+    bounds = _chunk_bounds(n_rows, chunks)
+    out = np.empty(expr.shape, expr.dtype)
+    with _RungCtx("chunked"):
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            part = expr[lo:hi]
+            out[lo:hi] = np.asarray(part.evaluate().glom())
+    return da.from_numpy(out)
+
+
+# -- the ladder ----------------------------------------------------------
+
+
+def _count(rung: str) -> None:
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "resilience_degrades",
+            "OOM degradations that produced a result").inc()
+        REGISTRY.counter(
+            f"resilience_degrade_{rung}",
+            f"degradations resolved at the {rung} rung").inc()
+
+
+def run_ladder(exc: BaseException, expr: Any, donated: List[Any],
+               mesh, plan: Any) -> Any:
+    """Walk the degradation ladder for an OOM-classified failure.
+
+    Returns the evaluated result (also seeded onto ``expr._result``
+    and recorded on the plan report / ``expr._resilience``); raises
+    the last OOM (annotated) if every rung also OOMs or none applies.
+    """
+    from . import classify as classify_mod
+    from .engine import _attach_note, _resilience_record
+
+    if not FLAGS.oom_degrade:
+        raise exc
+    rec = _resilience_record(expr, plan)
+    rec.setdefault("oom_events", 0)
+    rec["oom_events"] += 1
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "resilience_oom_events",
+            "dispatch failures classified as OOM").inc()
+    last = exc
+    for rung in RUNGS:
+        log_warn("resilience: OOM (%s) — degrading to rung %r",
+                 str(last)[:120], rung)
+        try:
+            with prof.span("degrade", rung=rung,
+                           error=type(last).__name__):
+                if rung == "chunked":
+                    result = _chunked_evaluate(expr, mesh)
+                else:
+                    result = _replan_evaluate(expr, donated, rung)
+        except NotApplicable:
+            continue
+        except Exception as e:  # noqa: BLE001 - ladder advance decision
+            if classify_mod.classify(e) != classify_mod.OOM:
+                _attach_note(
+                    e, f"while degrading to rung {rung!r} after: {last}")
+                raise
+            last = e
+            continue
+        rec["rung"] = rung
+        rec["degraded"] = True
+        _count(rung)
+        expr._result = result
+        expr._resilience = rec
+        return result
+    _attach_note(
+        last, "OOM degradation ladder exhausted (rungs tried: "
+        f"{', '.join(RUNGS)}); see docs/RESILIENCE.md")
+    from ..obs import numerics as numerics_mod
+
+    try:
+        path = numerics_mod.dump_crash(
+            reason="resilience: OOM degradation ladder exhausted",
+            plan_report=plan.report if plan is not None else None,
+            extra={"resilience": dict(rec)})
+        log_warn("resilience: ladder exhausted; crash dump at %s", path)
+    except Exception:
+        pass  # forensics must never mask the real failure
+    raise last

@@ -1,0 +1,295 @@
+"""Retry policy engine: what ``evaluate()`` does when a dispatch fails.
+
+``expr/base.evaluate`` wraps its dispatch calls; any exception lands
+in :func:`handle_failure`, which executes the classifier's decision
+table (:mod:`resilience.classify`):
+
+* **transient / io** — retry the SAME plan with exponential backoff
+  and jitter, up to ``FLAGS.retry_max`` attempts per failure episode
+  and ``FLAGS.retry_budget`` retries per plan lifetime. Each attempt
+  emits a ``retry`` trace span and the ``resilience_retries`` /
+  ``resilience_recovered`` counters. Real (non-injected) faults on a
+  dispatch that donated buffers are NOT retried — a failed execution
+  may already have consumed the donated HBM.
+* **oom** — hand off to the degradation ladder
+  (:mod:`resilience.degrade`): replan finer -> fusion off -> chunked.
+  Inside an already-degraded evaluation the OOM propagates instead,
+  so the OUTER ladder advances (no recursive ladders).
+* **deterministic** — fail fast: the exception is re-raised with the
+  plan summary attached as a PEP-678 note (plan key, root, site).
+  Retrying a deterministic compile error only repeats it.
+
+Exhausted retries and exhausted ladders feed ``dump_crash()``
+forensics (the PR-4 crash-dump machinery) before re-raising.
+
+:func:`retry_evaluate` is the driver-level loop the deprecated
+``utils/recovery.evaluate_with_recovery`` shim delegates to.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs.explain import key_hash
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
+from ..utils.log import log_warn
+from . import classify as cls
+from . import degrade
+
+FLAGS.define_int(
+    "retry_max", 3,
+    "Max transient-fault retries per failure episode inside "
+    "evaluate() (0 disables in-evaluate retry).")
+FLAGS.define_float(
+    "retry_backoff_s", 0.05,
+    "Base backoff before the first in-evaluate retry; doubles per "
+    "attempt (jittered +/-50%), capped at retry_backoff_max_s.")
+FLAGS.define_float(
+    "retry_backoff_max_s", 2.0,
+    "Backoff ceiling for in-evaluate retries.")
+FLAGS.define_int(
+    "retry_budget", 32,
+    "Lifetime retry budget per plan (keyed on the compile signature): "
+    "a plan that keeps failing transiently stops retrying once the "
+    "budget is spent, so a mis-classified deterministic fault cannot "
+    "retry forever.")
+FLAGS.define_bool(
+    "resilience", True,
+    "Master switch for the in-evaluate policy engine (classifier + "
+    "retry + OOM degradation). Off = dispatch failures propagate "
+    "raw, as before PR 5.")
+
+# deterministic jitter source (reproducible test timing, and
+# Math.random-free: the sequence does not depend on import order)
+_rng = random.Random(0xC0FFEE)
+
+# plan digest -> retries consumed (lifetime budget bookkeeping)
+_budget_used: Dict[str, int] = {}
+
+
+def reset() -> None:
+    """Forget per-plan retry budgets (test isolation)."""
+    _budget_used.clear()
+
+
+def _attach_note(exc: BaseException, note: str) -> None:
+    """PEP-678 note, with the pre-3.11 emulation expr/base uses."""
+    try:
+        if hasattr(exc, "add_note"):
+            exc.add_note(note)
+        else:
+            exc.__notes__ = getattr(exc, "__notes__", []) + [note]
+    except Exception:
+        pass  # slotted/frozen exceptions: keep the original
+
+
+def _resilience_record(expr: Any, plan: Any) -> Dict[str, Any]:
+    """The per-plan resilience record: lives on the plan report (so a
+    cache-hit ``st.explain`` shows it) AND on the expr (so explaining
+    an already-evaluated root still names the rung taken)."""
+    rec: Optional[Dict[str, Any]] = None
+    if plan is not None and plan.report is not None:
+        rec = plan.report.setdefault(
+            "resilience", {"retries": 0, "faults": [], "rung": None})
+    if rec is None:
+        rec = getattr(expr, "_resilience", None) or {
+            "retries": 0, "faults": [], "rung": None}
+    expr._resilience = rec
+    return rec
+
+
+def _plan_digest(plan: Any) -> str:
+    try:
+        return key_hash(plan.key) or "?"
+    except Exception:
+        return "?"
+
+
+def _sleep_backoff(attempt: int) -> float:
+    base = FLAGS.retry_backoff_s
+    if base <= 0:
+        return 0.0
+    delay = min(FLAGS.retry_backoff_max_s, base * (2 ** attempt))
+    delay *= 0.5 + _rng.random()  # +/-50% jitter: desynchronize fleets
+    time.sleep(delay)
+    return delay
+
+
+def _dump(reason: str, plan: Any, rec: Dict[str, Any]) -> None:
+    from ..obs import numerics as numerics_mod
+
+    try:
+        path = numerics_mod.dump_crash(
+            reason=reason,
+            plan_report=plan.report if plan is not None else None,
+            extra={"resilience": dict(rec)})
+        log_warn("resilience: %s; crash dump at %s", reason, path)
+    except Exception:
+        pass  # forensics must never mask the real failure
+
+
+def _donation_in_flight(leaves: List[Any], donated: List[Any]) -> bool:
+    from ..expr.base import _leaf_array
+
+    if donated:
+        return True
+    for leaf in leaves:
+        arr = _leaf_array(leaf)
+        if arr is not None and getattr(arr, "_donate_next", False):
+            return True
+    return False
+
+
+def handle_failure(exc: BaseException, expr: Any, plan: Any,
+                   leaves: List[Any], order: Tuple[int, ...],
+                   donated: List[Any], mesh) -> Any:
+    """Executed by ``evaluate()`` when a dispatch raised ``exc``.
+
+    Returns a result (retry or degradation succeeded) or re-raises.
+    """
+    if not FLAGS.resilience:
+        raise exc
+    kind = cls.classify(exc)
+    rec = _resilience_record(expr, plan)
+    rec["faults"].append(
+        {"class": kind, "error": f"{type(exc).__name__}: "
+                                 f"{str(exc)[:200]}"})
+
+    if kind == cls.OOM:
+        if degrade.active_rung() is not None:
+            # already inside a degraded re-plan: let the OUTER ladder
+            # advance to its next rung instead of nesting ladders
+            raise exc
+        return degrade.run_ladder(exc, expr, donated, mesh, plan)
+
+    if kind in (cls.TRANSIENT, cls.IO):
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "resilience_transient_faults",
+                "dispatch failures classified transient/io").inc()
+        if (not getattr(exc, "injected", False)
+                and _donation_in_flight(leaves, donated)):
+            _attach_note(
+                exc, "resilience: retry skipped — the failed dispatch "
+                "donated buffers, which a partial execution may "
+                "already have consumed; re-create the donated arrays "
+                "and re-evaluate")
+            raise exc
+        from ..expr import base
+
+        digest = _plan_digest(plan)
+        attempt = 0
+        last = exc
+        while attempt < FLAGS.retry_max:
+            used = _budget_used.get(digest, 0)
+            if used >= FLAGS.retry_budget:
+                _attach_note(
+                    last, f"resilience: per-plan retry budget "
+                    f"({FLAGS.retry_budget}) exhausted for plan "
+                    f"{digest}")
+                _dump("retry budget exhausted", plan, rec)
+                raise last
+            _budget_used[digest] = used + 1
+            delay = _sleep_backoff(attempt)
+            rec["retries"] += 1
+            if _METRICS_FLAG._value:
+                REGISTRY.counter(
+                    "resilience_retries",
+                    "dispatch retries attempted by the policy "
+                    "engine").inc()
+            with prof.span("retry", attempt=attempt, plan=digest,
+                           error_class=kind,
+                           backoff_ms=round(delay * 1e3, 1)) as rsp:
+                try:
+                    result = base._dispatch(expr, plan, leaves, order,
+                                            donated, mesh)
+                except Exception as e:  # classify and route the retry
+                    rsp.set(failed=type(e).__name__)
+                    k2 = cls.classify(e)
+                    rec["faults"].append(
+                        {"class": k2, "error": f"{type(e).__name__}: "
+                                               f"{str(e)[:200]}"})
+                    if k2 == cls.OOM:
+                        if degrade.active_rung() is not None:
+                            raise
+                        return degrade.run_ladder(e, expr, donated,
+                                                  mesh, plan)
+                    if k2 not in (cls.TRANSIENT, cls.IO):
+                        _attach_note(
+                            e, f"resilience: while retrying after a "
+                            f"{kind} fault (attempt {attempt + 1})")
+                        raise
+                    last = e
+                    attempt += 1
+                    continue
+            if _METRICS_FLAG._value:
+                REGISTRY.counter(
+                    "resilience_recovered",
+                    "evaluations recovered by retry").inc()
+            log_warn("resilience: recovered after %d retry(ies) "
+                     "(plan %s)", attempt + 1, digest)
+            return result
+        _attach_note(
+            last, f"resilience: {FLAGS.retry_max} retry(ies) "
+            f"exhausted for plan {digest} (transient fault persisted)")
+        _dump("transient retries exhausted", plan, rec)
+        raise last
+
+    # deterministic: fail fast, with the plan summary attached — the
+    # forensics a blind retry wrapper would have burned time hiding
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "resilience_deterministic_failures",
+            "dispatch failures classified deterministic (not "
+            "retried)").inc()
+    note = "resilience: deterministic failure — not retried"
+    if plan is not None and plan.report is not None:
+        r = plan.report
+        note += (f" (plan {r.get('plan_key')}, root {r.get('root')}"
+                 + (f", built at {r['site']}" if r.get("site") else "")
+                 + ")")
+    _attach_note(exc, note)
+    raise exc
+
+
+def retry_evaluate(expr: Any, retries: int = 2, backoff_s: float = 0.0,
+                   retryable: Optional[Tuple[type, ...]] = None,
+                   on_failure: Optional[Callable] = None) -> Any:
+    """Driver-level detection + lineage-recovery loop (the engine
+    behind the deprecated ``evaluate_with_recovery`` shim).
+
+    With ``retryable=None`` the CLASSIFIER decides: transient / io /
+    oom failures retry from lineage, deterministic user errors
+    propagate immediately (the old wrapper retried any
+    ``RuntimeError``, deterministic compile errors included). An
+    explicit ``retryable`` tuple keeps the legacy isinstance
+    behavior."""
+    for attempt in range(retries + 1):
+        try:
+            return expr.evaluate()
+        except Exception as e:  # detection: the failed dispatch raises
+            if retryable is not None:
+                ok = isinstance(e, retryable)
+            else:
+                ok = cls.classify(e) != cls.DETERMINISTIC
+            if not ok or attempt == retries:
+                raise
+            log_warn("retry_evaluate: attempt %d/%d failed (%s); "
+                     "recomputing from lineage", attempt + 1,
+                     retries + 1, e)
+            if _METRICS_FLAG._value:
+                REGISTRY.counter(
+                    "resilience_driver_retries",
+                    "driver-level lineage retries "
+                    "(retry_evaluate / the deprecated "
+                    "evaluate_with_recovery)").inc()
+            expr.invalidate()
+            if on_failure is not None:
+                on_failure(attempt, e)
+            if backoff_s:
+                time.sleep(backoff_s * (2 ** attempt))

@@ -1,0 +1,307 @@
+"""Deterministic fault injection: ``st.chaos`` / ``FLAGS.fault_inject``.
+
+The reference could test failure recovery by killing worker processes
+(SURVEY.md §5); the single-controller XLA runtime has no workers to
+kill, so failures must be *injected* at the seams where the real ones
+surface — and they must be injected deterministically, so every
+recovery path in :mod:`spartan_tpu.resilience` is exercisable in CPU
+CI and reproducible from a seed.
+
+Injection sites (the real seams):
+
+* ``dispatch`` — every executable run in ``expr/base._dispatch``
+  (both the first compile+run and steady-state dispatches). Faults:
+  ``transient`` (an UNAVAILABLE-style ``XlaRuntimeError`` analogue),
+  ``oom`` (a RESOURCE_EXHAUSTED analogue), ``slow`` (sleeps inside
+  the dispatch to trip the PR-4 watchdog, ``FLAGS.dispatch_timeout_s``).
+* ``compile`` — the first (trace + XLA compile) run only. Fault:
+  ``compile`` (an INVALID_ARGUMENT-style deterministic error).
+* ``checkpoint`` — ``utils/checkpoint`` save/load. Fault: ``io``
+  (an ``OSError``).
+
+Spec grammar (``FLAGS.fault_inject`` or ``st.chaos(spec)``): a
+comma-separated list of tokens::
+
+    transient@2        dispatch occurrence #2 (0-based) raises once
+    oom@4x3            dispatch occurrences 4,5,6 raise RESOURCE_EXHAUSTED
+    transient:0.05     each dispatch raises with p=0.05 (seeded, so the
+                       same seed reproduces the same fault sequence)
+    slow@3=0.5         dispatch occurrence #3 stalls 0.5 s (watchdog food)
+    compile@0          the first compile raises a deterministic error
+    io@1               the second checkpoint write raises OSError
+
+Injected exceptions carry ``injected=True`` and messages matching the
+real-world patterns (``UNAVAILABLE``, ``RESOURCE_EXHAUSTED``,
+``INVALID_ARGUMENT``), so they flow through the SAME classifier
+(:mod:`resilience.classify`) as genuine runtime faults. Every fired
+fault increments ``resilience_faults_injected`` and emits a ``chaos``
+trace span.
+
+Imports only the config/obs layers (below expr/array), so the expr
+dispatch path and the checkpoint IO path can both consult it without
+cycles. The hot-path cost with chaos off is one module-attribute read
+(``_ACTIVE is None``) per dispatch.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from ..obs import trace as trace_mod
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..utils.config import FLAGS
+from ..utils.log import log_warn
+
+FLAGS.define_str(
+    "fault_inject", "",
+    "Deterministic fault-injection spec (chaos testing): comma-"
+    "separated tokens like 'transient@2', 'oom@4x3', 'transient:0.05', "
+    "'slow@3=0.5', 'compile@0', 'io@1'. Installed by st.initialize() "
+    "or st.chaos(); empty = no injection. See docs/RESILIENCE.md.")
+FLAGS.define_int(
+    "fault_seed", 0,
+    "Seed for probabilistic fault-injection tokens (kind:prob): the "
+    "same seed reproduces the same fault sequence.")
+
+
+class InjectedTransientError(RuntimeError):
+    """Injected analogue of a transient XlaRuntimeError (UNAVAILABLE)."""
+
+    injected = True
+    fault_kind = "transient"
+
+
+class InjectedOOMError(RuntimeError):
+    """Injected analogue of a dispatch RESOURCE_EXHAUSTED."""
+
+    injected = True
+    fault_kind = "oom"
+
+
+class InjectedCompileError(RuntimeError):
+    """Injected analogue of a deterministic XLA compile error."""
+
+    injected = True
+    fault_kind = "compile"
+
+
+class InjectedCheckpointError(OSError):
+    """Injected checkpoint IO failure."""
+
+    injected = True
+    fault_kind = "io"
+
+
+_EXC = {
+    "transient": (InjectedTransientError,
+                  "UNAVAILABLE: injected transient fault "
+                  "(chaos {site}#{idx})"),
+    "oom": (InjectedOOMError,
+            "RESOURCE_EXHAUSTED: injected out-of-memory: failed to "
+            "allocate device buffer (chaos {site}#{idx})"),
+    "compile": (InjectedCompileError,
+                "INVALID_ARGUMENT: injected compile error "
+                "(chaos {site}#{idx})"),
+    "io": (InjectedCheckpointError,
+           "injected checkpoint IO error (chaos {site}#{idx})"),
+}
+
+_KINDS = ("transient", "oom", "slow", "compile", "io")
+_TOKEN = re.compile(
+    r"^(?P<kind>[a-z]+)"
+    r"(?:@(?P<at>\d+))?"
+    r"(?:x(?P<count>\d+))?"
+    r"(?::(?P<prob>[0-9.]+))?"
+    r"(?:=(?P<dur>[0-9.]+))?$")
+
+
+class FaultSpec:
+    """One parsed token of a chaos spec."""
+
+    __slots__ = ("kind", "at", "count", "prob", "dur")
+
+    def __init__(self, token: str):
+        m = _TOKEN.match(token.strip())
+        if not m or m.group("kind") not in _KINDS:
+            raise ValueError(
+                f"bad fault token {token!r}: expected "
+                f"kind[@N][xCOUNT][:PROB][=DUR] with kind in {_KINDS}")
+        self.kind = m.group("kind")
+        self.at = int(m.group("at")) if m.group("at") is not None else None
+        self.count = int(m.group("count") or 1)
+        self.prob = float(m.group("prob")) if m.group("prob") else 0.0
+        self.dur = float(m.group("dur")) if m.group("dur") else 0.05
+        if self.at is None and not self.prob:
+            raise ValueError(
+                f"fault token {token!r} needs a deterministic site "
+                "(@N) or a probability (:p)")
+
+    def hits(self, idx: int, seed: int) -> bool:
+        if self.at is not None and self.at <= idx < self.at + self.count:
+            return True
+        if self.prob:
+            # per-occurrence seeded draw: deterministic given (seed,
+            # kind, idx), independent of call interleaving AND of the
+            # process (crc32, not str hash — PYTHONHASHSEED varies)
+            word = zlib.crc32(f"{seed}:{self.kind}:{idx}".encode())
+            return random.Random(word).random() < self.prob
+        return False
+
+    def __repr__(self) -> str:
+        return (f"FaultSpec({self.kind}, at={self.at}, "
+                f"count={self.count}, prob={self.prob})")
+
+
+class ChaosPlan:
+    """A seeded, installed fault-injection plan (see module docstring).
+
+    Usable as a context manager: entering installs it (if not already
+    installed), exiting uninstalls. ``fired`` records every injected
+    fault (kind/site/occurrence) for assertions and bench reporting.
+    """
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = [
+            FaultSpec(tok) for tok in spec.split(",") if tok.strip()]
+        self.fired: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._n_dispatch = 0
+        self._n_compile = 0
+        self._n_checkpoint = 0
+
+    # -- occurrence counters ------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {"dispatch": self._n_dispatch,
+                    "compile": self._n_compile,
+                    "checkpoint": self._n_checkpoint}
+
+    def _record(self, spec: FaultSpec, site: str, idx: int) -> None:
+        rec = {"kind": spec.kind, "site": site, "occurrence": idx}
+        with self._lock:
+            self.fired.append(rec)
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "resilience_faults_injected",
+                "synthetic faults raised by the chaos plan").inc()
+        trace_mod.instant("chaos", error=spec.kind != "slow",
+                          kind=spec.kind, site=site, occurrence=idx)
+        log_warn("chaos: injecting %s fault at %s#%d", spec.kind, site,
+                 idx)
+
+    def fire(self, site: str) -> None:
+        """Consult the plan at one injection site; raises (or sleeps,
+        for ``slow``) when a token matches the current occurrence."""
+        with self._lock:
+            if site == "checkpoint":
+                ckpt_idx = self._n_checkpoint
+                self._n_checkpoint += 1
+                disp_idx = comp_idx = None
+            else:
+                disp_idx = self._n_dispatch
+                self._n_dispatch += 1
+                ckpt_idx = None
+                comp_idx = None
+                if site == "compile":
+                    comp_idx = self._n_compile
+                    self._n_compile += 1
+        for spec in self.specs:
+            if spec.kind == "io":
+                idx = ckpt_idx
+            elif spec.kind == "compile":
+                idx = comp_idx
+            else:  # transient / oom / slow fire on any executable run
+                idx = disp_idx
+            if idx is None or not spec.hits(idx, self.seed):
+                continue
+            self._record(spec, site, idx)
+            if spec.kind == "slow":
+                time.sleep(spec.dur)
+                continue
+            exc_type, msg = _EXC[spec.kind]
+            raise exc_type(msg.format(site=site, idx=idx))
+
+    # -- installation --------------------------------------------------
+
+    def install(self) -> "ChaosPlan":
+        global _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "ChaosPlan":
+        return self.install()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
+
+    def __repr__(self) -> str:
+        return (f"ChaosPlan({self.spec!r}, seed={self.seed}, "
+                f"fired={len(self.fired)})")
+
+
+# The one installed plan; expr/base._dispatch and utils/checkpoint
+# read this module attribute (a None check is the whole chaos-off
+# cost).
+_ACTIVE: Optional[ChaosPlan] = None
+
+
+def chaos(spec: Optional[str] = None, seed: Optional[int] = None
+          ) -> Optional[ChaosPlan]:
+    """Install a deterministic fault-injection plan (``st.chaos``).
+
+    ``spec`` defaults to ``FLAGS.fault_inject``; ``seed`` to
+    ``FLAGS.fault_seed``. Passing an empty spec clears any installed
+    plan and returns None. The returned plan doubles as a context
+    manager (exiting uninstalls it)::
+
+        with st.chaos("transient@1,oom@3", seed=0):
+            result = expr.evaluate()   # survives both faults
+    """
+    global _ACTIVE
+    if spec is None:
+        spec = FLAGS.fault_inject
+    if seed is None:
+        seed = FLAGS.fault_seed
+    if not spec:
+        _ACTIVE = None
+        return None
+    return ChaosPlan(spec, seed).install()
+
+
+def chaos_clear() -> None:
+    """Uninstall any active chaos plan."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[ChaosPlan]:
+    return _ACTIVE
+
+
+def install_from_flags() -> Optional[ChaosPlan]:
+    """Install a plan from ``FLAGS.fault_inject`` if set (called by
+    ``st.initialize()``); no-op when the flag is empty."""
+    if FLAGS.fault_inject:
+        return chaos(FLAGS.fault_inject, FLAGS.fault_seed)
+    return None
+
+
+def fire(site: str) -> None:
+    """Module-level injection hook: no-op unless a plan is installed."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(site)

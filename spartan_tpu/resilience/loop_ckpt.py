@@ -1,0 +1,285 @@
+"""Checkpointed ``st.loop``: periodic carry snapshots + resume.
+
+``st.loop(..., checkpoint_every=N, checkpoint_path=p, resume=p)``
+splits the on-device loop into segments of N iterations. Each segment
+is one ``lax.fori_loop`` dispatch (the plan caches make every segment
+after the first a cache hit, and the iteration count is a traced
+scalar, so a short final segment reuses the same executable); after
+each segment the carries are snapshotted ATOMICALLY through
+``utils/checkpoint`` (temp dir + ``os.replace``, per-shard CRC32 —
+a killed process can never leave a half-written snapshot as the
+latest). On a failed segment — after the in-evaluate policy engine
+has already exhausted its retries — the driver restores the last good
+snapshot and re-runs from there; ``resume=path`` does the same across
+process restarts: a killed 20-iteration run resumed from its last
+snapshot reproduces the uninterrupted final carry bit-for-bit
+(segmentation does not change per-iteration math).
+
+Composes with the PR-4 loop sentinel: ``health=True`` /
+``early_exit=True`` / ``stall_tol`` are forwarded to every segment,
+and an early-exited segment (divergence or convergence stall) ends
+the whole loop at that snapshot.
+
+Layout under ``checkpoint_path``::
+
+    step_00000005/           carry snapshots after iteration 5
+        carry0/  carry1/...  per-carry shard blobs + CRC manifests
+        loop_meta.json       {"step": 5, "carries": k}
+    step_00000010/
+    LATEST.json              {"step": 10, "dir": "step_00000010"}
+
+Only the last two snapshots are kept (the latest plus one fallback).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
+from ..utils.log import log_info, log_warn
+from . import classify as cls
+
+FLAGS.define_int(
+    "loop_restore_max", 3,
+    "Max checkpoint restores per checkpointed st.loop run before the "
+    "failure propagates (guards against a persistently-failing "
+    "segment looping forever).")
+
+_LATEST = "LATEST.json"
+_KEEP_SNAPSHOTS = 2
+
+
+def _count(name: str, help_: str) -> None:
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(name, help_).inc()
+
+
+def _step_dir(path: str, step: int) -> str:
+    return os.path.join(path, f"step_{step:08d}")
+
+
+def save_snapshot(path: str, step: int, carries: List[Any]) -> str:
+    """Atomically snapshot the carries after iteration ``step``."""
+    from ..utils import checkpoint as ckpt
+
+    os.makedirs(path, exist_ok=True)
+    tmp = os.path.join(path, f".tmp_step_{step}_{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    with prof.span("loop_checkpoint", step=step):
+        ckpt.save_tree(tmp, {f"carry{i}": c
+                             for i, c in enumerate(carries)})
+        with open(os.path.join(tmp, "loop_meta.json"), "w") as f:
+            json.dump({"step": int(step), "carries": len(carries)}, f)
+        final = _step_dir(path, step)
+        shutil.rmtree(final, ignore_errors=True)
+        os.replace(tmp, final)
+        # LATEST.json is the commit marker: written (atomically) only
+        # after the snapshot dir landed, so a reader never sees a
+        # LATEST pointing at a partial snapshot
+        ltmp = os.path.join(path, f".{_LATEST}.{os.getpid()}")
+        with open(ltmp, "w") as f:
+            json.dump({"step": int(step),
+                       "dir": os.path.basename(final)}, f)
+        os.replace(ltmp, os.path.join(path, _LATEST))
+    _count("resilience_loop_checkpoints",
+           "carry snapshots written by checkpointed st.loop")
+    _prune(path, keep=_KEEP_SNAPSHOTS)
+    return final
+
+
+def _prune(path: str, keep: int) -> None:
+    dirs = sorted(d for d in os.listdir(path) if d.startswith("step_"))
+    for d in dirs[:-keep]:
+        shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+
+
+def load_latest(path: str) -> Optional[Tuple[int, List[Any]]]:
+    """(step, carries) of the last committed snapshot, or None."""
+    from ..utils import checkpoint as ckpt
+
+    marker = os.path.join(path, _LATEST)
+    if not os.path.exists(marker):
+        return None
+    with open(marker) as f:
+        latest = json.load(f)
+    snap = os.path.join(path, latest["dir"])
+    with open(os.path.join(snap, "loop_meta.json")) as f:
+        meta = json.load(f)
+    tree = ckpt.load_tree(snap)
+    carries = [tree[f"carry{i}"] for i in range(int(meta["carries"]))]
+    return int(meta["step"]), carries
+
+
+def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
+                      *, with_index: bool, donate_init: bool,
+                      health: bool, early_exit: bool, stall_tol: float,
+                      every: int, path: Optional[str],
+                      resume: Optional[str]) -> Any:
+    """The driver behind ``st.loop(..., checkpoint_every=...)``.
+
+    Runs eagerly (segments must dispatch to snapshot between them) and
+    returns the final carries wrapped as ``ValExpr``s, so the call
+    site keeps the lazy-loop surface (``.glom()`` / ``.evaluate()``).
+    """
+    from ..expr.base import ScalarExpr, ValExpr, as_expr
+    from ..expr.loop import loop as _loop
+    from ..obs import numerics as obs_numerics
+
+    n_expr = as_expr(n_iters)
+    if not isinstance(n_expr, ScalarExpr):
+        raise TypeError(
+            "st.loop(..., checkpoint_every=/resume=) needs a static "
+            "(Python int) iteration count — segmentation happens on "
+            "the host")
+    n = int(n_expr.pyvalue)
+    if path is None:
+        path = resume
+    every = int(every) if every and every > 0 else n
+    if every < n and path is None:
+        raise ValueError(
+            "st.loop(checkpoint_every=...) needs checkpoint_path= "
+            "(or resume=) to write snapshots to")
+
+    start = 0
+    carries: Optional[List[Any]] = None
+    if resume is not None:
+        latest = load_latest(resume) if os.path.isdir(resume) else None
+        if latest is not None:
+            start, carries = latest
+            _count("resilience_loop_resumes",
+                   "checkpointed loops resumed from a snapshot")
+            log_info("st.loop resume: restored iteration %d from %s",
+                     start, resume)
+        else:
+            log_info("st.loop resume: no snapshot under %r; starting "
+                     "fresh", resume)
+    if carries is None:
+        carries = [as_expr(i).evaluate() for i in init]
+
+    track_health = bool(health or early_exit)  # early_exit implies it
+    rec: Dict[str, Any] = {
+        "loop": True, "n": n, "checkpoint_every": every,
+        "resumed_from": start if start else None,
+        "restores": 0, "segments": 0, "retries": 0, "rung": None,
+    }
+    step = start
+    restores = 0
+    stopped_early = False
+    with prof.span("ckpt_loop", n=n, every=every, start=start):
+        while step < n and not stopped_early:
+            seg = min(every, n - step)
+            offset = step
+
+            if with_index:
+                def body(i, *cs, _off=offset):
+                    # per-segment offset rides a traced scalar, so the
+                    # global index is right and the plan still caches
+                    return body_fn(i + _off, *cs)
+            else:
+                body = body_fn
+
+            try:
+                with prof.span("loop_segment", step=step, seg=seg):
+                    args = [ValExpr(c) for c in carries]
+                    items = _loop(
+                        seg, body, *args, with_index=with_index,
+                        donate_init=donate_init, health=health,
+                        early_exit=early_exit, stall_tol=stall_tol)
+                    tup = (items,) if not isinstance(items, tuple) \
+                        else items
+                    results = [it.evaluate() for it in tup]
+                    if track_health:
+                        # health callbacks drain asynchronously; the
+                        # early-exit decision below reads the series
+                        obs_numerics._flush_effects(tuple(results))
+            except Exception as e:
+                if cls.classify(e) == cls.DETERMINISTIC:
+                    raise
+                restores += 1
+                rec["restores"] = restores
+                _count("resilience_loop_restores",
+                       "failed loop segments restored from the last "
+                       "good snapshot")
+                if restores > FLAGS.loop_restore_max:
+                    try:
+                        e.add_note(
+                            f"checkpointed st.loop: "
+                            f"{FLAGS.loop_restore_max} restores "
+                            f"exhausted at iteration {step}")
+                    except Exception:
+                        pass
+                    raise
+                latest = (load_latest(path)
+                          if path and os.path.isdir(path) else None)
+                if latest is not None:
+                    step, carries = latest
+                    log_warn("st.loop: segment failed (%s); restored "
+                             "iteration %d from checkpoint",
+                             str(e)[:120], step)
+                    continue
+                if any(getattr(c, "is_donated", False)
+                       for c in carries):
+                    try:
+                        e.add_note(
+                            "checkpointed st.loop: no snapshot to "
+                            "restore and the segment donated its "
+                            "carries — cannot safely re-run")
+                    except Exception:
+                        pass
+                    raise
+                log_warn("st.loop: segment failed (%s); no snapshot "
+                         "yet — re-running from held carries",
+                         str(e)[:120])
+                continue
+
+            # merge segment-level resilience records (retry/degrade
+            # done by the policy engine inside evaluate)
+            for it in tup:
+                r = getattr(it, "_resilience", None)
+                if r:
+                    rec["retries"] += r.get("retries", 0)
+                    if r.get("rung"):
+                        rec["rung"] = r["rung"]
+            carries = results
+            rec["segments"] += 1
+            if track_health:
+                label = f"loop#{tup[0].loop._id}"
+                series = obs_numerics.loop_health(label)
+                executed = len(series)
+                if early_exit and executed and executed < seg:
+                    step += executed
+                    stopped_early = True
+                else:
+                    step += seg
+            else:
+                step += seg
+            if path is not None and (every < n or resume is not None):
+                try:
+                    save_snapshot(path, step, carries)
+                except OSError as e:
+                    # a failed snapshot must not kill a healthy run:
+                    # the carries live on, and the next boundary
+                    # retries the write (the atomic-swap protocol
+                    # guarantees the previous snapshot is still good)
+                    rec["checkpoint_failures"] = (
+                        rec.get("checkpoint_failures", 0) + 1)
+                    _count("resilience_checkpoint_failures",
+                           "loop snapshot writes that failed "
+                           "(non-fatal; previous snapshot intact)")
+                    log_warn("st.loop: snapshot at iteration %d "
+                             "failed (%s); continuing — previous "
+                             "snapshot remains the restore point",
+                             step, str(e)[:120])
+
+    out = []
+    for c in carries:
+        v = ValExpr(c)
+        v._resilience = rec
+        out.append(v)
+    return out[0] if len(out) == 1 else tuple(out)

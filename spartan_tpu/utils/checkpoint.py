@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
+import zlib
 from typing import Dict, Optional, Union
 
 import jax
@@ -29,6 +31,32 @@ from ..parallel import mesh as mesh_mod
 _MANIFEST = "manifest.json"
 
 
+def _fire_checkpoint_fault() -> None:
+    """Chaos seam (resilience/faults.py): an installed plan's ``io``
+    tokens raise OSError here, so checkpoint-failure recovery paths
+    are exercisable in CI. One module-attribute read when off."""
+    from ..resilience import faults as _faults
+
+    if _faults._ACTIVE is not None:
+        _faults.fire("checkpoint")
+
+
+def _swap_into_place(tmp: str, path: str) -> None:
+    """Atomically promote the fully-written ``tmp`` dir to ``path``:
+    a reader (or a crash) can only ever observe the old complete
+    checkpoint or the new complete one, never a partial write."""
+    if os.path.isdir(path):
+        old = path + f".old-{os.getpid()}"
+        shutil.rmtree(old, ignore_errors=True)
+        os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        os.replace(tmp, path)
+
+
 def _axes_to_json(axes):
     return [list(a) if isinstance(a, tuple) else a for a in axes]
 
@@ -42,18 +70,36 @@ def save(path: str, array: Union[DistArray, "np.ndarray"],
     """Write one DistArray (or Expr, forced first): shard blobs +
     manifest under ``path``/.
 
+    Crash-safe (single-process): everything is written into a temp
+    dir next to ``path`` and atomically ``os.replace``d into place,
+    so a process killed mid-save can never leave a half-written
+    checkpoint where a complete one (or nothing) is expected, and the
+    manifest carries a per-shard CRC32 that :func:`load` verifies —
+    a corrupt blob fails loudly, naming the shard file.
+
     Multi-process aware (SURVEY.md §5 on multi-host): the manifest
     enumerates the GLOBAL shard grid; each process writes only the
     blobs whose owning device (the lowest-id device holding that
     extent, so replicas are written exactly once cluster-wide) is
-    local, and only process 0 writes the manifest. ``path`` must be a
+    local, and only process 0 writes the manifest — the manifest is
+    the commit marker there (processes write into ``path`` in place;
+    CRCs cover only rank-0-local shards). ``path`` must be a
     filesystem every process reaches."""
     if not isinstance(array, DistArray):
         if hasattr(array, "evaluate"):  # an Expr: force it
             array = array.evaluate()
         else:
             array = da.from_numpy(np.asarray(array))
-    os.makedirs(path, exist_ok=True)
+    _fire_checkpoint_fault()
+    single = jax.process_count() == 1
+    # single-process: stage in a temp dir and swap; multi-process:
+    # in place (every process must target the SAME dir, and the
+    # barrier+manifest ordering below is the commit protocol)
+    dest = (os.path.abspath(path) + f".tmp-{os.getpid()}"
+            if single else path)
+    if single:
+        shutil.rmtree(dest, ignore_errors=True)
+    os.makedirs(dest, exist_ok=True)
     jarr = array.jax_array
     idx_map = jarr.sharding.devices_indices_map(tuple(array.shape))
     local = {s.device: s for s in jarr.addressable_shards}
@@ -69,14 +115,17 @@ def save(path: str, array: Union[DistArray, "np.ndarray"],
             continue
         seen.add(idx)
         fname = "shard_" + "_".join(f"{a}-{b}" for a, b in idx) + ".bin"
-        shards.append({"ul": [a for a, _ in idx],
-                       "lr": [b for _, b in idx],
-                       "file": fname})
+        rec = {"ul": [a for a, _ in idx],
+               "lr": [b for _, b in idx],
+               "file": fname}
         if dev in local:
-            paths.append(os.path.join(path, fname))
-            arrays.append(np.ascontiguousarray(local[dev].data))
+            buf = np.ascontiguousarray(local[dev].data)
+            paths.append(os.path.join(dest, fname))
+            arrays.append(buf)
+            rec["crc32"] = zlib.crc32(buf)
+        shards.append(rec)
     native.write_blobs(paths, arrays, nthreads)
-    if jax.process_count() > 1:
+    if not single:
         # the manifest is the checkpoint's commit marker: it must not
         # land before every process's blobs have — barrier first
         from jax.experimental import multihost_utils
@@ -90,9 +139,11 @@ def save(path: str, array: Union[DistArray, "np.ndarray"],
             "mesh": {k: int(v) for k, v in array.mesh.shape.items()},
             "shards": shards,
         }
-        with open(os.path.join(path, _MANIFEST), "w") as f:
+        with open(os.path.join(dest, _MANIFEST), "w") as f:
             json.dump(manifest, f)
-    if jax.process_count() > 1:
+    if single:
+        _swap_into_place(dest, path)
+    else:
         # no rank may report the save complete before the commit
         # marker exists — a premature teardown on rank 1's return
         # would otherwise race rank 0's manifest write
@@ -116,14 +167,27 @@ def _load_host(path: str, nthreads: int = 8):
         paths.append(os.path.join(path, rec["file"]))
         targets.append((ext, buf))
     native.read_blobs(paths, [b for _, b in targets], nthreads)
-    for ext, buf in targets:
+    for rec, (ext, buf) in zip(manifest["shards"], targets):
+        want = rec.get("crc32")
+        if want is not None:
+            got = zlib.crc32(np.ascontiguousarray(buf))
+            if got != want:
+                raise ValueError(
+                    f"checkpoint shard {rec['file']!r} under {path!r} "
+                    f"failed CRC32 verification (manifest {want}, "
+                    f"read {got}): the blob is corrupt or truncated")
         full[ext.to_slice()] = buf
     return full, manifest
 
 
 def load(path: str, tiling: Optional[tiling_mod.Tiling] = None,
          nthreads: int = 8) -> DistArray:
-    """Read a checkpoint and re-shard it onto the current mesh."""
+    """Read a checkpoint and re-shard it onto the current mesh.
+
+    Shards carrying a manifest CRC32 (every single-process save) are
+    verified as read; a corrupt blob raises ``ValueError`` naming the
+    shard file."""
+    _fire_checkpoint_fault()
     full, manifest = _load_host(path, nthreads)
     if tiling is None:
         saved = _axes_from_json(manifest["tiling"])

@@ -196,6 +196,24 @@ FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force()."
 #       plan report + last health word to crash_dump_path.
 #   crash_dump_path      (obs/numerics.py, default "") — crash-report
 #       destination (empty = spartan_tpu_crash_<pid>.json in tmp).
+# The resilience layer's switches (spartan_tpu/resilience/) likewise
+# live with their consumers (docs/RESILIENCE.md):
+#   resilience           (engine.py, default True)  — master switch for
+#       the in-evaluate policy engine (classify + retry + OOM degrade).
+#   retry_max / retry_backoff_s / retry_backoff_max_s / retry_budget
+#       (engine.py, defaults 3 / 0.05 / 2.0 / 32) — transient-retry
+#       policy: attempts per episode, jittered exponential backoff,
+#       lifetime budget per plan.
+#   oom_degrade          (degrade.py, default True)  — walk the
+#       finer-tiling -> fusion-off -> chunked ladder on OOM; each rung
+#       keyed into the plan/compile caches.
+#   degrade_chunks       (degrade.py, default 0)     — row blocks for
+#       the chunked rung (0 = one per mesh device).
+#   fault_inject / fault_seed (faults.py, defaults "" / 0) — seeded
+#       chaos spec ('transient@2,oom@4x3,slow@1=0.5,io@0'), installed
+#       by st.initialize() or st.chaos().
+#   loop_restore_max     (loop_ckpt.py, default 3)   — checkpoint
+#       restores per checkpointed st.loop before the failure escapes.
 FLAGS.define_bool(
     "trace_annotations", True,
     "Wrap every expr node's kernel body in jax.named_scope during "

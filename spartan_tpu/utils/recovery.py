@@ -1,31 +1,30 @@
-"""Failure detection + lineage recovery (SURVEY.md §5).
+"""DEPRECATED façade over :mod:`spartan_tpu.resilience`.
 
-The reference's master marked workers dead on missed heartbeats and
-could at best recompute lost tiles from the expression DAG. In the
-single-controller XLA runtime, DETECTION is the runtime error the
-failed dispatch raises (device loss / preemption surfaces as an
-exception from the blocking call — there is no silent partial state,
-because arrays are immutable and a failed program commits nothing),
-and RECOVERY is recompute-from-lineage: exprs are deterministic, so
-dropping the cached result and re-forcing the DAG rebuilds it — the
-reference's recompute-lost-tiles story without per-tile bookkeeping.
+This module used to be the whole recovery story: a blind
+retry-on-``RuntimeError`` loop around ``evaluate()``. PR 5 replaced
+it with the in-evaluate policy engine — ``evaluate()`` itself now
+classifies every dispatch failure (transient → backoff retry under a
+per-plan budget, OOM → the degradation ladder, deterministic → fail
+fast with the plan report attached) and ``st.loop`` checkpoints and
+resumes — so callers normally need NOTHING: a plain ``evaluate()``
+already recovers. See docs/RESILIENCE.md.
 
-This module packages that loop; the fault-injection test
-(tests/test_aux.py) exercises it end to end.
+:func:`evaluate_with_recovery` is kept as a thin deprecated shim for
+driver-level lineage retry (invalidate + re-force across the whole
+plan, e.g. after reloading a checkpoint in ``on_failure``), delegating
+to :func:`spartan_tpu.resilience.engine.retry_evaluate`. One behavior
+change, per the classifier: with the default ``retryable=None`` the
+CLASSIFIER decides — deterministic user/compile errors are no longer
+retried (the old default retried any ``RuntimeError``). Passing an
+explicit ``retryable`` tuple keeps the legacy isinstance behavior.
 """
 
 from __future__ import annotations
 
-import time
+import warnings
 from typing import Any, Callable, Optional, Tuple
 
-from .log import log_warn
-
-# Exception types that indicate a (possibly transient) runtime/device
-# failure rather than a user error. jax's device-side faults
-# (XlaRuntimeError/JaxRuntimeError) subclass RuntimeError; OSError
-# covers the IO layer during checkpoint reads. ValueError/TypeError
-# etc. are USER errors and must not be retried.
+# kept for back-compat importers; the classifier supersedes it
 _DEFAULT_RETRYABLE: Tuple[type, ...] = (RuntimeError, OSError)
 
 
@@ -33,26 +32,32 @@ def evaluate_with_recovery(expr: Any, retries: int = 2,
                            backoff_s: float = 0.0,
                            retryable: Optional[Tuple[type, ...]] = None,
                            on_failure: Optional[Callable] = None):
-    """Force ``expr`` with detection + lineage recovery.
+    """Force ``expr`` with driver-level detection + lineage recovery.
 
-    On a retryable runtime failure: drop the cached partial result
+    .. deprecated::
+        ``evaluate()`` now runs the resilience policy engine itself
+        (classifier + retry + OOM degradation, ``resilience_*``
+        metrics, crash-dump forensics); use it directly, or
+        ``resilience.engine.retry_evaluate`` for an explicit
+        driver-level loop. This shim delegates there and will be
+        removed.
+
+    On a retryable failure: drop the cached partial result
     (``invalidate`` — lineage, i.e. the DAG itself, is the recovery
     log), optionally call ``on_failure(attempt, exc)`` (hook for
     re-initializing a backend or reloading a checkpoint), and
-    re-force. Non-retryable exceptions propagate immediately.
+    re-force. With ``retryable=None`` the resilience classifier
+    decides retryability; an explicit tuple keeps isinstance
+    semantics. Non-retryable exceptions propagate immediately.
     """
-    if retryable is None:
-        retryable = _DEFAULT_RETRYABLE
-    for attempt in range(retries + 1):
-        try:
-            return expr.evaluate()
-        except retryable as e:  # detection: the failed dispatch raises
-            log_warn("evaluate failed (attempt %d/%d): %s",
-                     attempt + 1, retries + 1, e)
-            if attempt == retries:  # no further attempt: fail fast
-                raise
-            expr.invalidate()
-            if on_failure is not None:
-                on_failure(attempt, e)
-            if backoff_s:
-                time.sleep(backoff_s * (2 ** attempt))
+    warnings.warn(
+        "evaluate_with_recovery is deprecated: evaluate() now runs "
+        "the resilience policy engine itself (classifier + retry + "
+        "OOM degradation; docs/RESILIENCE.md). For an explicit "
+        "driver-level loop use "
+        "spartan_tpu.resilience.engine.retry_evaluate.",
+        DeprecationWarning, stacklevel=2)
+    from ..resilience.engine import retry_evaluate
+
+    return retry_evaluate(expr, retries=retries, backoff_s=backoff_s,
+                          retryable=retryable, on_failure=on_failure)

@@ -105,47 +105,41 @@ def test_device_memory_stats_shape():
     assert isinstance(stats, dict)
 
 
-def test_fault_injection_lineage_recovery(monkeypatch):
-    """SURVEY.md §5 failure recovery: a TRANSIENT execution fault (the
-    analogue of a lost worker/tile) surfaces to the driver, and
-    recompute-from-lineage produces the correct result once the fault
-    clears — exprs are deterministic, so the DAG is the recovery log."""
-    from spartan_tpu.expr import base as base_mod
+def test_fault_injection_lineage_recovery():
+    """SURVEY.md §5 failure recovery, migrated to the resilience
+    injection API (PR 5): a TRANSIENT execution fault (the analogue
+    of a lost worker/tile) is injected at the real dispatch seam by
+    ``st.chaos`` and retried by the in-evaluate policy engine —
+    exprs are deterministic, so the DAG is the recovery log and a
+    plain ``evaluate()`` recovers by itself."""
+    from spartan_tpu.utils.config import FLAGS
 
     x = st.from_numpy(np.arange(64, dtype=np.float32).reshape(8, 8))
     e = (x * 2.0 + 1.0).sum(axis=0)
     expected = (np.arange(64, dtype=np.float32).reshape(8, 8)
                 * 2.0 + 1.0).sum(axis=0)
 
-    real_evaluate = base_mod.evaluate
-    state = {"failures_left": 2, "attempts": 0}
-
-    def flaky_evaluate(expr):
-        state["attempts"] += 1
-        if state["failures_left"] > 0:
-            state["failures_left"] -= 1
-            raise RuntimeError("injected device fault")
-        return real_evaluate(expr)
-
-    monkeypatch.setattr(base_mod, "evaluate", flaky_evaluate)
-    for attempt in range(3):  # driver-side retry-from-lineage loop
-        try:
-            out = base_mod.evaluate(e)
-            break
-        except RuntimeError:
-            e.invalidate()  # drop any partial result; lineage remains
-    else:
-        raise AssertionError("recovery never succeeded")
-    monkeypatch.undo()
-    assert state["attempts"] == 3
+    before = st.metrics()["counters"].get("resilience_retries", 0)
+    saved = FLAGS.retry_backoff_s
+    FLAGS.retry_backoff_s = 0.0
+    try:
+        with st.chaos("transient@0x2") as plan:  # two failed dispatches
+            out = e.evaluate()
+    finally:
+        FLAGS.retry_backoff_s = saved
+    assert [f["kind"] for f in plan.fired] == ["transient", "transient"]
+    after = st.metrics()["counters"].get("resilience_retries", 0)
+    assert after - before == 2  # attempt 1+2 faulted, attempt 3 ran
     np.testing.assert_allclose(np.asarray(out.glom()), expected,
                                rtol=1e-6)
 
 
 def test_evaluate_with_recovery_api(monkeypatch):
-    """The packaged detection+recovery loop (utils/recovery.py):
-    transient runtime faults retry from lineage; user errors
-    propagate immediately."""
+    """The legacy driver-level loop (utils/recovery.py) survives as a
+    DEPRECATED shim over resilience.engine.retry_evaluate: transient
+    faults retry from lineage, and — the classifier routing — user
+    errors propagate immediately even though they are RuntimeError
+    siblings under the old blind default."""
     from spartan_tpu.utils.recovery import evaluate_with_recovery
 
     x = st.from_numpy(np.full((4, 4), 2.0, np.float32))
@@ -156,18 +150,20 @@ def test_evaluate_with_recovery_api(monkeypatch):
 
     def flaky(self):
         calls["n"] += 1
-        if calls["n"] <= 2:
-            raise RuntimeError("injected device loss")
+        if calls["n"] <= 2:  # a transient-classified status message
+            raise RuntimeError("UNAVAILABLE: injected device loss")
         return real(self)
 
     monkeypatch.setattr(type(e), "evaluate", flaky)
-    out = evaluate_with_recovery(
-        e, retries=3, on_failure=lambda a, exc: calls["hook"].append(a))
+    with pytest.warns(DeprecationWarning, match="policy engine"):
+        out = evaluate_with_recovery(
+            e, retries=3,
+            on_failure=lambda a, exc: calls["hook"].append(a))
     monkeypatch.undo()
     assert calls["n"] == 3 and calls["hook"] == [0, 1]
     np.testing.assert_allclose(np.asarray(out.glom()), 64.0)
 
-    # a user error is NOT retried
+    # a user error is NOT retried...
     bad = st.from_numpy(np.ones((4, 4), np.float32))
     b = (bad * 1.0).sum()
 
@@ -177,10 +173,46 @@ def test_evaluate_with_recovery_api(monkeypatch):
 
     monkeypatch.setattr(type(b), "evaluate", user_error)
     before = calls["n"]
-    with pytest.raises(ValueError):
-        evaluate_with_recovery(b, retries=3)
+    with pytest.warns(DeprecationWarning):
+        with pytest.raises(ValueError):
+            evaluate_with_recovery(b, retries=3)
     monkeypatch.undo()
     assert calls["n"] == before + 100  # exactly one attempt
+
+    # ... and neither is a DETERMINISTIC RuntimeError under the
+    # classifier default (the old shim would have retried it)
+    c = (bad * 2.0).sum()
+
+    def compile_error(self):
+        calls["n"] += 1000
+        raise RuntimeError("INVALID_ARGUMENT: bad layout")
+
+    monkeypatch.setattr(type(c), "evaluate", compile_error)
+    before = calls["n"]
+    with pytest.warns(DeprecationWarning):
+        with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
+            evaluate_with_recovery(c, retries=3)
+    monkeypatch.undo()
+    assert calls["n"] == before + 1000  # exactly one attempt
+
+    # an explicit retryable tuple keeps legacy isinstance semantics
+    d = (bad * 3.0).sum()
+    calls["m"] = 0
+    real_d = type(d).evaluate
+
+    def generic_fault(self):
+        calls["m"] += 1
+        if calls["m"] == 1:
+            raise RuntimeError("some generic failure")
+        return real_d(self)
+
+    monkeypatch.setattr(type(d), "evaluate", generic_fault)
+    with pytest.warns(DeprecationWarning):
+        out = evaluate_with_recovery(d, retries=2,
+                                     retryable=(RuntimeError,))
+    monkeypatch.undo()
+    assert calls["m"] == 2
+    np.testing.assert_allclose(np.asarray(out.glom()), 48.0)
 
 
 def test_persistent_compilation_cache_flag(tmp_path):

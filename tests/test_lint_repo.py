@@ -112,3 +112,63 @@ def test_debug_callbacks_allowed_in_obs_and_loop():
     # unrelated .print attributes (not jax.debug) are NOT flagged
     other = ast.parse("console.print('x')\nobj.debug.callback()\n")
     assert lint_repo.lint_debug_callbacks("/x/y.py", other) == []
+
+
+def test_catches_bare_recovery(tmp_path):
+    bad = tmp_path / "retry_mod.py"
+    bad.write_text(
+        "def f(expr):\n"
+        "    try:\n"
+        "        return expr.evaluate()\n"
+        "    except RuntimeError:\n"
+        "        return expr.evaluate()\n"
+        "def g(expr):\n"
+        "    try:\n"
+        "        out = expr.force()\n"
+        "    except Exception as e:\n"
+        "        out = None\n"
+        "    return out\n"
+        "def h(fn):\n"
+        "    try:\n"
+        "        return jax.jit(fn)()\n"
+        "    except:\n"
+        "        return None\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_bare_recovery(str(bad), tree)
+    assert sum(f.rule == "bare-recovery" for f in findings) == 3
+    # ... and the policy engine is named in the remedy
+    assert all("resilience" in f.message for f in findings)
+
+
+def test_bare_recovery_allows_engine_route_and_resilience_dir():
+    # the sanctioned boundary: a handler routing into the engine
+    routed = ast.parse(
+        "def ev(expr):\n"
+        "    try:\n"
+        "        return _dispatch(expr)\n"
+        "    except Exception as e:\n"
+        "        return _handle_failure(e, expr)\n")
+    assert lint_repo.lint_bare_recovery("/x/y.py", routed) == []
+    # the resilience subsystem itself may catch broadly
+    eng = os.path.join(lint_repo.REPO, "spartan_tpu", "resilience",
+                       "engine.py")
+    broad = ast.parse(
+        "try:\n"
+        "    expr.evaluate()\n"
+        "except Exception:\n"
+        "    pass\n")
+    assert lint_repo.lint_bare_recovery(eng, broad) == []
+    # specific exceptions around dispatch are fine anywhere
+    specific = ast.parse(
+        "try:\n"
+        "    expr.evaluate()\n"
+        "except ValueError:\n"
+        "    pass\n")
+    assert lint_repo.lint_bare_recovery("/x/y.py", specific) == []
+    # broad except NOT around dispatch calls is rule-5-clean too
+    unrelated = ast.parse(
+        "try:\n"
+        "    x = parse(text)\n"
+        "except Exception:\n"
+        "    x = None\n")
+    assert lint_repo.lint_bare_recovery("/x/y.py", unrelated) == []

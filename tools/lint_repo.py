@@ -32,6 +32,19 @@ Four repo-specific rules that generic linters cannot know:
    the crash-dump machinery, and its host cost escapes every
    overhead gate.
 
+5. No broad exception handling (bare ``except:``, ``except
+   Exception``, ``except RuntimeError``) around compile/dispatch
+   calls (``evaluate`` / ``force`` / ``recompute`` / ``_dispatch`` /
+   ``jit``) outside ``spartan_tpu/resilience/`` (the resilient-
+   execution PR): ad-hoc catch-and-retry around the dispatch path is
+   exactly the blind-retry bug class the classifier + policy engine
+   replaced — it retries deterministic errors, bypasses the per-plan
+   retry budget, and its failures are invisible to the
+   ``resilience_*`` metrics and crash-dump forensics. The one
+   sanctioned shape outside ``resilience/`` is a handler that routes
+   straight into the engine (calls ``handle_failure``), which is how
+   ``expr/base.evaluate`` wires the boundary.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -67,6 +80,16 @@ _DEBUG_CB_ALLOWED_DIRS = (os.path.join("spartan_tpu", "obs") + os.sep,)
 _DEBUG_CB_ALLOWED_FILES = {os.path.join("spartan_tpu", "expr",
                                         "loop.py")}
 _DEBUG_CB_FNS = {"callback", "print"}
+
+# rule 5: the only place allowed to catch broadly around the
+# compile/dispatch path is the resilience subsystem itself
+_RECOVERY_ALLOWED_DIRS = (os.path.join("spartan_tpu", "resilience")
+                          + os.sep,)
+_BROAD_HANDLERS = {"Exception", "BaseException", "RuntimeError"}
+_DISPATCH_CALLS = {"evaluate", "force", "recompute", "_dispatch", "jit"}
+# a handler that immediately routes into the policy engine is the
+# sanctioned boundary shape (expr/base.evaluate)
+_ENGINE_ROUTES = {"handle_failure", "_handle_failure"}
 
 
 class Finding:
@@ -212,6 +235,63 @@ def lint_debug_callbacks(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def _call_names(nodes) -> Set[str]:
+    """Function names called anywhere under ``nodes`` (Name or the
+    final Attribute segment: ``jax.jit`` -> ``jit``)."""
+    out: Set[str] = set()
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name):
+                    out.add(fn.id)
+                elif isinstance(fn, ast.Attribute):
+                    out.add(fn.attr)
+    return out
+
+
+def lint_bare_recovery(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 5: no broad except around compile/dispatch calls outside
+    resilience/ — blind catch-and-retry bypasses the classifier, the
+    retry budget and the resilience metrics/forensics."""
+    rel = os.path.relpath(path, REPO)
+    if any(rel.startswith(d) for d in _RECOVERY_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        guarded = _call_names(node.body) & _DISPATCH_CALLS
+        if not guarded:
+            continue
+        for handler in node.handlers:
+            t = handler.type
+            if t is None:
+                caught = {"<bare>"}
+            else:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                caught = set()
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        caught.add(e.id)
+                    elif isinstance(e, ast.Attribute):
+                        caught.add(e.attr)
+            broad = ({"<bare>"} & caught) or (caught & _BROAD_HANDLERS)
+            if not broad:
+                continue
+            if _call_names(handler.body) & _ENGINE_ROUTES:
+                continue  # routes into the policy engine: sanctioned
+            findings.append(Finding(
+                path, handler.lineno, "bare-recovery",
+                f"broad except ({', '.join(sorted(broad))}) around "
+                f"{'/'.join(sorted(guarded))}: recovery decisions "
+                "belong to spartan_tpu/resilience (classifier + "
+                "policy engine) — catch a specific exception, or "
+                "route the failure into "
+                "resilience.engine.handle_failure"))
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -295,6 +375,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_shard_map_imports(path, tree))
         findings.extend(lint_raw_timing(path, tree))
         findings.extend(lint_debug_callbacks(path, tree))
+        findings.extend(lint_bare_recovery(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
