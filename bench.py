@@ -146,6 +146,21 @@ def _arm_stage_forensics(stage: str) -> None:
     signal.signal(signal.SIGTERM, _dump)
 
 
+def _env_diag() -> dict:
+    """Active-FLAGS snapshot (non-default values only) + plan/compile
+    cache sizes at stage end. Rides every stage's JSON line into
+    ``stage_diags`` (ROADMAP 'Perf trajectory' follow-up: the r05 TPU
+    cold-start timeouts can't be attributed to PR 2-5 flag defaults vs
+    compile-cache growth because no round recorded either — from this
+    round on the committed artifact carries both)."""
+    from spartan_tpu.expr import base as expr_base
+    from spartan_tpu.utils.config import FLAGS
+
+    return {"flags_nondefault": FLAGS.snapshot_nondefault(),
+            "plan_cache_size": expr_base.plan_cache_size(),
+            "compile_cache_size": expr_base.compile_cache_size()}
+
+
 def _plan_diag() -> dict:
     """Plan-cache hit/miss counters and per-phase host timers for the
     stage's JSON line + a stderr diagnostic (utils/profiling): a
@@ -210,6 +225,7 @@ def worker_dot(k: int, reps: int, precision: str | None) -> None:
         "precision": prec_label,
         "loop_k": k,
         "plan_cache": plan,
+        "env": _env_diag(),
     }), flush=True)
 
 
@@ -271,6 +287,7 @@ def worker_kmeans(iters: int, reps: int) -> None:
         "platform": platform,
         "iters": iters,
         "plan_cache": _plan_diag(),
+        "env": _env_diag(),
     }), flush=True)
 
 
@@ -321,6 +338,7 @@ def worker_aux(reps: int) -> None:
         "logreg_iters_per_sec": round(1.0 / lg, 3),
         "ssvd_seconds": round(sv, 4),
         "platform": platform,
+        "env": _env_diag(),
     }), flush=True)
 
 
@@ -377,7 +395,29 @@ def worker_chaos(iters: int, seed: int) -> None:
             "resilience_loop_checkpoints", 0),
         "seconds": round(wall, 3),
         "platform": platform,
+        "env": _env_diag(),
     }), flush=True)
+
+
+def worker_serve(clients: int, per_client: int) -> None:
+    """Opt-in serving stage (``bench.py --serve``): open-loop
+    many-client load through ``spartan_tpu/serve`` vs a serial
+    ``evaluate()`` loop (benchmarks/serving_latency.py) on the default
+    platform. One JSON line: p50/p99 request latency, throughput,
+    coalescing hit ratio, the >=3x coalesced-speedup gate and the
+    <=1% serve-off overhead gate (graded by the parent against
+    benchmarks/thresholds.json)."""
+    jax = _fix_platform()
+    platform = jax.devices()[0].platform
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import serving_latency as sl
+
+    _arm_stage_forensics("serve")
+    rec = sl.measure(clients=clients, per_client=per_client)
+    rec["platform"] = platform
+    rec["env"] = _env_diag()
+    print(json.dumps(rec), flush=True)
 
 
 def _benchguard():
@@ -472,6 +512,18 @@ def _diag(stage, reason, rc=None, err="", note=None):
     return d
 
 
+def _ok_diag(stage_name, stage):
+    """Success diagnostic carrying the worker's ``env`` record (active
+    non-default FLAGS + plan/compile-cache sizes, ``_env_diag``) — so
+    every stage in ``stage_diags``, not just the failures, leaves the
+    state the r05 cold-start postmortem was missing. Pops ``env`` off
+    the stage record: it lives in the diags, not the headline line."""
+    d = {"stage": stage_name, "reason": "ok"}
+    if isinstance(stage, dict):
+        d.update(stage.pop("env", None) or {})
+    return d
+
+
 def main() -> None:
     result = None
     diags = []
@@ -502,6 +554,7 @@ def main() -> None:
             print(f"[bench] stage K={k} failed rc={rc}", file=sys.stderr)
             continue
         result = stage
+        diags.append(_ok_diag(f"dot_k{k}", stage))
         print(f"[bench] stage K={k} ok in {time.perf_counter() - t0:.1f}s:"
               f" {stage['value']} {stage['unit']}", file=sys.stderr)
     default_dead = result is None
@@ -541,6 +594,7 @@ def main() -> None:
             hi = _parse_stage(out)
             if hi is not None:
                 result["gflops_f32_highest"] = hi["value"]
+                diags.append(_ok_diag(f"dot_k{kh}_highest", hi))
                 print(f"[bench] highest-precision stage: {hi['value']} "
                       f"GFLOPS", file=sys.stderr)
             else:
@@ -573,6 +627,7 @@ def main() -> None:
                                          env_extra={"JAX_PLATFORMS": "cpu"})
             km = _parse_stage(out)
         if km is not None:
+            diags.append(_ok_diag("kmeans", km))
             result["kmeans_iters_per_sec"] = km["value"]
             result["kmeans_platform"] = km.get("platform")
             cpu_km = _baseline("kmeans_1m", "iters_per_sec_1m")
@@ -603,6 +658,7 @@ def main() -> None:
             out, err, aux_rc = _run_stage("--worker-aux", [3], 540)
             aux = _parse_stage(out)
             if aux is not None:
+                diags.append(_ok_diag("aux", aux))
                 metrics = {k: aux.get(k) for k in (
                     "pagerank_iters_per_sec", "logreg_iters_per_sec",
                     "ssvd_seconds")}
@@ -630,14 +686,16 @@ def main() -> None:
             out, err, ch_rc = _run_stage("--worker-chaos", [20, 0], 420)
             ch = _parse_stage(out)
             if ch is not None:
-                diags.append({
-                    "stage": "chaos", "reason": "ok", "rc": ch_rc,
+                d = _ok_diag("chaos", ch)
+                d.update({
+                    "rc": ch_rc,
                     "recovered_iterations": ch["recovered_iterations"],
                     "matches_fault_free": ch["matches_fault_free"],
                     "faults_injected": ch["faults_injected"],
                     "retries": ch["retries"],
                     "degrades": ch["degrades"],
                 })
+                diags.append(d)
                 result["chaos"] = ch
                 print(f"[bench] chaos stage: {ch['faults_injected']} "
                       f"fault(s) injected, {ch['retries']} retry(ies), "
@@ -647,6 +705,31 @@ def main() -> None:
                 diags.append(_diag("chaos", "no JSON output", rc=ch_rc,
                                    err=err))
                 print("[bench] chaos stage failed", file=sys.stderr)
+        # serving stage (opt-in with --serve): many-client open-loop
+        # load through spartan_tpu/serve — p50/p99 latency, throughput
+        # and the coalescing gates, graded against thresholds.json
+        if "--serve" in sys.argv and not default_dead:
+            out, err, sv_rc = _run_stage("--worker-serve", [16, 30], 540)
+            sv = _parse_stage(out)
+            if sv is not None:
+                diags.append(_ok_diag("serve", sv))
+                g = _benchguard().check(
+                    {"serve_coalesced_speedup":
+                         sv.get("serve_coalesced_speedup"),
+                     "serve_off_overhead_ratio":
+                         sv.get("serve_off_overhead_ratio")},
+                    sv.get("platform", ""))
+                sv["guard_pass"] = g["pass"] if g["checked"] else None
+                result["serving"] = sv
+                print(f"[bench] serve stage: "
+                      f"{sv['serve_coalesced_speedup']}x coalesced, "
+                      f"p99={sv['latency_p99_ms']}ms, off-path "
+                      f"{sv['serve_off_overhead_ratio']}, guard_pass="
+                      f"{sv['guard_pass']}", file=sys.stderr)
+            else:
+                diags.append(_diag("serve", "no JSON output", rc=sv_rc,
+                                   err=err))
+                print("[bench] serve stage failed", file=sys.stderr)
         if diags:
             # structured list (stage/reason/rc/stderr_tail/crash_file),
             # not the old concatenated string
@@ -677,5 +760,7 @@ if __name__ == "__main__":
         worker_aux(int(sys.argv[2]))
     elif len(sys.argv) >= 4 and sys.argv[1] == "--worker-chaos":
         worker_chaos(int(sys.argv[2]), int(sys.argv[3]))
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--worker-serve":
+        worker_serve(int(sys.argv[2]), int(sys.argv[3]))
     else:
         main()

@@ -225,11 +225,31 @@ def resilience_overhead(st):
     return ro.measure(iters=60, n=512 if SMALL else 4096)
 
 
+def serving_overhead(st):
+    """Serving-engine gates (benchmarks/serving_latency.py): 16-client
+    coalesced throughput vs a serial evaluate() loop (>=3x is the
+    ISSUE-6 gate — one compile, one dispatch, N responses) and the
+    off-path toll of the serve layer on plain evaluate() (<=1%)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serving_latency as sl
+
+    if SMALL:
+        return sl.measure(clients=16, per_client=8, reps=3, iters=48,
+                          n=128)
+    return sl.measure()
+
+
 def _with_metrics(fn, st):
     """Run one benchmark config and attach the ``st.metrics()``
     snapshot it produced (phase p50/p95, plan-hit ratio, counters) to
     its record — from this PR on, BENCH_*.json trajectories carry
-    per-phase data that can be compared across rounds."""
+    per-phase data that can be compared across rounds. Each record
+    also carries the non-default FLAGS in effect and the plan/compile
+    cache sizes AFTER the config ran (r05 cold-start follow-up: a TPU
+    regression must be attributable to PR 2-5 flag defaults vs
+    compile-cache growth from the committed artifact alone — the full
+    defaults snapshot rides the report top level)."""
+    from spartan_tpu.expr import base as expr_base
     from spartan_tpu.utils import profiling
 
     profiling.reset_counters()
@@ -237,6 +257,9 @@ def _with_metrics(fn, st):
     snap = st.metrics()
     rec["metrics"] = {
         "plan_cache": snap["plan_cache"],
+        "flags_nondefault": st.FLAGS.snapshot_nondefault(),
+        "plan_cache_size": expr_base.plan_cache_size(),
+        "compile_cache_size": expr_base.compile_cache_size(),
         "counters": snap["counters"],
         "phase_us": {
             name.split(":", 1)[1]: {
@@ -278,6 +301,10 @@ def guard_metrics(report) -> dict:
         "resilience_off_overhead_ratio":
             report["resilience_overhead"].get(
                 "resilience_off_overhead_ratio"),
+        "serve_coalesced_speedup":
+            report["serving_overhead"].get("serve_coalesced_speedup"),
+        "serve_off_overhead_ratio":
+            report["serving_overhead"].get("serve_off_overhead_ratio"),
     }
 
 
@@ -302,7 +329,11 @@ def main():
         "obs_overhead": _with_metrics(obs_overhead, st),
         "numerics_overhead": _with_metrics(numerics_overhead, st),
         "resilience_overhead": _with_metrics(resilience_overhead, st),
+        "serving_overhead": _with_metrics(serving_overhead, st),
     }
+    # full flag state once at report level (the per-record
+    # flags_nondefault deltas are diffs against these defaults)
+    report["flags"] = st.FLAGS.snapshot()
     metrics = guard_metrics(report)
     if not SMALL:
         # grade BEFORE any threshold rewrite: an --update-thresholds
@@ -326,9 +357,15 @@ def main():
         fixed = {"verify_check_vs_cold_ratio": 0.1,
                  "obs_overhead_ratio": 0.05,
                  "numerics_off_overhead_ratio": 0.01,
-                 "resilience_off_overhead_ratio": 0.01}
+                 "resilience_off_overhead_ratio": 0.01,
+                 "serve_off_overhead_ratio": 0.01}
+        # fixed FLOORS (ISSUE gates on ratios that must stay high):
+        # coalescing must amortize dispatch >=3x across 16 clients
+        fixed_min = {"serve_coalesced_speedup": 3.0}
         for k, v in metrics.items():
-            if k in fixed:
+            if k in fixed_min:
+                entry[k] = {"min": fixed_min[k]}
+            elif k in fixed:
                 entry[k] = {"max": fixed[k]}
             elif k.endswith("seconds"):
                 entry[k] = {"max": round(v / 0.7, 4)}

@@ -1,0 +1,270 @@
+"""Serving-engine acceptance gates (ISSUE 6): open-loop many-client
+load through ``spartan_tpu/serve`` vs a serial ``evaluate()`` loop.
+
+Three measurements, one JSON line:
+
+* **coalesced throughput** — ``--clients`` threads (default 16) each
+  submit ``--per-client`` identical-signature requests through a
+  ``ServeEngine`` (open loop: all submissions fire before any result
+  is awaited); wall time from first submit to last resolution.
+  ``serve_coalesced_speedup`` = serve throughput / serial throughput,
+  the committed >=3x gate: coalescing must amortize the per-launch
+  host + XLA-runtime overhead across clients (one compile, one
+  dispatch, N responses). Request DAGs are PRE-BUILT in both arms —
+  the serving system's work starts at submission; constructing the
+  request payload is client application logic and identical either
+  way. Latency p50/p99 (future-stamped: submit -> resolve) and the
+  coalescing hit ratio ride along. Both arms take the median of
+  ``--reps`` runs; batched executable variants are compiled in a
+  warmup pass (steady-state measurement, like every other gate here).
+* **serial baseline** — the same pre-built requests through plain
+  ``evaluate()`` in one thread (the pre-serving caller). Serial and
+  serve arms ALTERNATE rep by rep and the committed speedup is the
+  median of per-rep ratios: adjacent-in-time pairs cancel the load
+  drift of a shared box, where arm-at-a-time medians swung ~2x.
+* **off-path overhead** — steady-state ``evaluate()`` with the serve
+  layer present but unused: 'base' arm = unbounded legacy plan cache
+  (``plan_cache_max=0``, LRU reorder skipped) and no engine; 'off'
+  arm = default bounded LRU cache with the default engine started but
+  idle (its workers park on the queue's condition variable — zero
+  steady-state CPU). ``serve_off_overhead_ratio`` = median of
+  pairwise off/base ratios - 1 (same drift-cancelling structure) is
+  the committed <=1% gate (the serving PR must not tax non-serving
+  callers).
+
+The workload is ``(x + y).sum() * s`` on shared array leaves with a
+per-request scalar ``s`` (scalars are weak-typed leaves outside the
+raw-DAG signature, so every request coalesces under one plan while
+computing its own answer). The shape is deliberate: the serial arm
+recomputes the map+reduce over the shared operands for every request,
+while the coalescer's argument deduplication maps shared leaves with
+``in_axes=None`` — so XLA hoists the shared compute out of the client
+axis and each coalesced batch pays it ONCE (the DrJAX
+broadcast-operand construction; see serve/coalesce.py). That, plus
+amortizing the per-launch host + XLA-runtime overhead, is what the
+>=3x gate certifies. Clients gather-wait (last future first): an
+open-loop client that parks once instead of waking per result.
+
+Usage: python benchmarks/serving_latency.py [--clients N]
+       [--per-client M] [--reps R] [--iters K] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(clients: int = 16, per_client: int = 30, reps: int = 5,
+            iters: int = 96, n: int = 512) -> dict:
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # the XLA:CPU async dispatch thread intermittently deadlocks
+        # when host threads dispatch onto 8 virtual devices sharing
+        # one core (same lottery tests/conftest.py removes);
+        # synchronous dispatch applies to BOTH arms, so the speedup
+        # ratio stays honest
+        try:
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
+        except (AttributeError, ValueError):
+            pass
+    import spartan_tpu as st
+    from spartan_tpu.obs.metrics import REGISTRY
+    from spartan_tpu.utils import profiling
+
+    rng = np.random.RandomState(0)
+    x = st.as_expr(rng.rand(n, n).astype(np.float32)).evaluate()
+    y = st.as_expr(rng.rand(n, n).astype(np.float32)).evaluate()
+    xe, ye = st.as_expr(x), st.as_expr(y)
+    total = clients * per_client
+    scalar = iter(range(1, 10_000_000))
+
+    def build():
+        return (xe + ye).sum() * float(next(scalar))
+
+    st.serve.shutdown_default()
+    float(build().glom())  # solo plan + executable warm
+
+    engine = st.ServeEngine(workers=2, batch_window_s=0.0005,
+                            max_batch=32)
+    engine.start()
+    # warm every quantized (power-of-two) batched variant: compiles are
+    # a one-time cost the steady state never pays
+    b = engine.max_batch
+    while b >= 2:
+        futs = [engine.submit(build()) for _ in range(b)]
+        for f in futs:
+            f.result(timeout=300)
+        b //= 2
+
+    def run_serial() -> float:
+        exprs = [build() for _ in range(total)]
+        with profiling.stopwatch() as sw:
+            for e in exprs:
+                e.evaluate()
+        return sw.elapsed
+
+    lat: list = []
+    errs: list = []
+
+    def run_serve() -> float:
+        reqs = [[build() for _ in range(per_client)]
+                for _ in range(clients)]
+        futures: list = []
+        flock = threading.Lock()
+
+        def client(cid: int) -> None:
+            try:
+                futs = [engine.submit(e, tenant=f"client{cid}")
+                        for e in reqs[cid]]
+                with flock:
+                    futures.extend(futs)
+                # gather-wait: park on the last-submitted future first
+                # (FIFO dispatch resolves it last) so the client wakes
+                # ~once instead of once per batch — fewer GIL handoffs
+                futs[-1].result(timeout=300)
+                for f in futs:
+                    f.result(timeout=300)
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        with profiling.stopwatch() as sw:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        lat.extend(f.t_resolved - f.t_submit for f in futures
+                   if f.t_resolved)
+        return sw.elapsed
+
+    # alternate the arms: each rep yields an adjacent-in-time
+    # (serial, serve) pair whose ratio cancels box-load drift
+    serial_walls, serve_walls, ratios = [], [], []
+    for _ in range(reps):
+        ws = run_serial()
+        wv = run_serve()
+        serial_walls.append(ws)
+        serve_walls.append(wv)
+        ratios.append(ws / wv)
+    wall_serial = float(np.median(serial_walls))
+    wall_serve = float(np.median(serve_walls))
+    thr_serial = total / wall_serial
+    thr_serve = total / wall_serve
+    speedup = float(np.median(ratios))
+    lat.sort()
+    counts = REGISTRY.counter_values()
+    coalesced = counts.get("serve_coalesced_requests", 0)
+    submitted = counts.get("serve_requests", 0)
+    engine.stop()
+
+    # -- off-path overhead: serve present but unused --------------------
+    def step():
+        float(build().glom())
+
+    step()
+    pair_ratios = []
+    times = {"base": [], "off": []}
+    st.serve.shutdown_default()
+    prev_max = st.FLAGS.plan_cache_max
+    block = 12  # iterations per arm block
+
+    def base_block() -> float:
+        """'base' = the pre-serving stack: unbounded legacy plan
+        cache, no engine. One flag write per BLOCK (a write
+        invalidates the memoized flags key, ~30µs on the next
+        evaluate — toggling per iteration would tax both arms ~5%
+        and drown the gate in its own measurement noise)."""
+        st.FLAGS.plan_cache_max = 0
+        step()  # absorb the flags-key recompute
+        ts = []
+        for _ in range(block):
+            with profiling.stopwatch() as sw:
+                step()
+            ts.append(sw.elapsed)
+        times["base"].extend(ts)
+        # per-block MIN: scheduler noise only ever ADDS time, so the
+        # block minimum is the best estimate of the arm's true cost
+        return float(np.min(ts))
+
+    def off_block() -> float:
+        """'off' = this PR's defaults, serve layer idle: bounded LRU
+        cache + the default engine started with its workers parked."""
+        st.FLAGS.plan_cache_max = prev_max
+        st.serve.default_engine()
+        step()
+        ts = []
+        for _ in range(block):
+            with profiling.stopwatch() as sw:
+                step()
+            ts.append(sw.elapsed)
+        times["off"].extend(ts)
+        return float(np.min(ts))
+
+    try:
+        base_block(), off_block()  # position warmup
+        for i in range(max(4, iters // block)):
+            # adjacent blocks share the box's instantaneous load, and
+            # ABBA ordering cancels second-position effects; the gate
+            # grades the median of pairwise block-median ratios
+            if i % 2 == 0:
+                t_b, t_o = base_block(), off_block()
+            else:
+                t_o, t_b = off_block(), base_block()
+            pair_ratios.append(t_o / t_b)
+    finally:
+        st.FLAGS.plan_cache_max = prev_max
+        st.serve.shutdown_default()
+    t_base = float(np.median(times["base"]))
+    t_off = float(np.median(times["off"]))
+    off_ratio = float(np.median(pair_ratios)) - 1.0
+
+    def pct(q: float) -> float:
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(round(q * (len(lat) - 1))))]
+
+    return {
+        "metric": "serving_latency",
+        "clients": clients,
+        "per_client": per_client,
+        "requests_per_rep": total,
+        "reps": reps,
+        "n": n,
+        "serial_throughput_rps": round(thr_serial, 1),
+        "serve_throughput_rps": round(thr_serve, 1),
+        "serve_coalesced_speedup": round(speedup, 3),
+        "latency_p50_ms": round(pct(0.50) * 1e3, 3),
+        "latency_p99_ms": round(pct(0.99) * 1e3, 3),
+        "coalesce_hit_ratio": round(
+            coalesced / submitted if submitted else 0.0, 3),
+        "errors": errs[:3],
+        "wall_us_per_iter_base": round(t_base * 1e6, 1),
+        "wall_us_per_iter_serve_off": round(t_off * 1e6, 1),
+        "serve_off_overhead_ratio": round(max(0.0, off_ratio), 4),
+    }
+
+
+def main() -> None:
+    kw = {}
+    for flag, key, cast in (("--clients", "clients", int),
+                            ("--per-client", "per_client", int),
+                            ("--reps", "reps", int),
+                            ("--iters", "iters", int)):
+        if flag in sys.argv:
+            kw[key] = cast(sys.argv[sys.argv.index(flag) + 1])
+    if "--small" in sys.argv:
+        kw["n"] = 128
+    print(json.dumps(measure(**kw)))
+
+
+if __name__ == "__main__":
+    main()

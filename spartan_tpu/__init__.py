@@ -36,6 +36,9 @@ from .obs import (AuditReport, ExplainReport, Watchpoint, audit, explain,
                   trace_export, unwatch, watch)
 from . import resilience
 from .resilience import ChaosPlan, chaos, chaos_clear
+from . import serve
+from .serve import (Backpressure, DeadlineExceeded, EvalFuture,
+                    ServeEngine, evaluate_async)
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -51,7 +54,9 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "trace_events", "trace_clear",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
             "loop_health",
-            "resilience", "chaos", "chaos_clear", "ChaosPlan"]
+            "resilience", "chaos", "chaos_clear", "ChaosPlan",
+            "serve", "ServeEngine", "EvalFuture", "evaluate_async",
+            "Backpressure", "DeadlineExceeded"]
            + list(_expr_all))
 
 

@@ -18,6 +18,7 @@ import itertools
 import os
 import threading
 import warnings
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -33,6 +34,7 @@ from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
 from ..resilience import degrade as degrade_mod
 from ..resilience import faults as faults_mod
+from ..utils import config as config_mod
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from ..utils.log import log_debug
@@ -192,6 +194,18 @@ class Expr:
 
     def evaluate(self, donate: Sequence[Any] = ()) -> DistArray:
         return evaluate(self, donate=donate)
+
+    def evaluate_async(self, donate: Sequence[Any] = (),
+                       tenant: Optional[str] = None,
+                       deadline_s: Optional[float] = None):
+        """Submit this expr to the concurrent serving engine
+        (spartan_tpu/serve): returns an ``EvalFuture`` immediately;
+        identical-signature requests from concurrent callers coalesce
+        into one batched dispatch. See docs/SERVING.md."""
+        from ..serve import evaluate_async as _ea
+
+        return _ea(self, donate=donate, tenant=tenant,
+                   deadline_s=deadline_s)
 
     def force(self, donate: Sequence[Any] = ()) -> DistArray:
         return evaluate(self, donate=donate)
@@ -424,6 +438,18 @@ class Expr:
 
 # -- leaf nodes ---------------------------------------------------------
 
+# numpy dtype -> canonical string for structural signatures:
+# ``str(dtype)`` re-derives the name each call (~3µs), and leaf
+# signing is on the per-request serving hot path
+_dtype_strs: Dict[Any, str] = {}
+
+
+def _dtype_str(dt: Any) -> str:
+    s = _dtype_strs.get(dt)
+    if s is None:
+        s = _dtype_strs[dt] = str(dt)
+    return s
+
 
 class ValExpr(Expr):
     """Leaf wrapping an evaluated DistArray (the reference's ``Val``)."""
@@ -446,8 +472,8 @@ class ValExpr(Expr):
         raise RuntimeError("leaf must be seeded into env before lowering")
 
     def _sig(self, ctx: "_SigCtx") -> Tuple:
-        return ("val", ctx.leaf_pos(self), self._shape, str(self._dtype),
-                self.value.tiling.axes)
+        return ("val", ctx.leaf_pos(self), self._shape,
+                _dtype_str(self._dtype), self.value.tiling.axes)
 
     def _default_tiling(self) -> Tiling:
         return self.value.tiling
@@ -663,7 +689,7 @@ class _PlanSigCtx(_SigCtx):
             # would substitute (no forced marker: the substituted
             # ValExpr never carries one)
             sig = ("val", self.leaf_pos(node), node._shape,
-                   str(node._dtype), node._result.tiling.axes)
+                   _dtype_str(node._dtype), node._result.tiling.axes)
             self._visit[node._id] = len(self._memo)
             self._memo[node._id] = sig
             return sig
@@ -707,9 +733,94 @@ class _Exec:
         self.warm = False
 
 
+# -- shared evaluation state + locking discipline ------------------------
+#
+# Everything below is shared by every thread that evaluates (the serve
+# engine's workers, st.explain, plain evaluate() callers). The locking
+# discipline, also documented in spartan_tpu/serve/__init__.py:
+#
+#   * ``_cache_lock`` guards BOTH ``_plan_cache`` and ``_compile_cache``
+#     (they evict together). It is held only for dict operations — never
+#     across an optimize, trace, compile or dispatch — so a slow miss on
+#     one thread cannot stall hits on another; the price is that two
+#     threads racing the same miss may both build the plan and the
+#     loser's work is discarded (``setdefault`` keeps the winner's).
+#   * every OTHER module goes through the accessors (``lookup_plan`` /
+#     ``store_plan`` / ``cached_executable`` / the clear/size helpers);
+#     ``tools/lint_repo.py`` rule 6 forbids touching ``_plan_cache`` /
+#     ``_compile_cache`` / ``_cache_lock`` outside this file.
+#   * the metrics registry, trace ring, chaos plan and retry budgets
+#     take their own locks (obs/metrics.py, obs/trace.py,
+#     resilience/faults.py, resilience/engine.py); none of them is ever
+#     held while calling into this module, and ``_cache_lock`` is never
+#     held while calling out — the lock graph has no cycles.
+
+# define() returns the Flag; the hot lookup reads ._value directly
+# (one attribute load) instead of FLAGS.__getattr__'s dict walk
+_PLAN_CACHE_MAX_FLAG = FLAGS.define_int(
+    "plan_cache_max", 512,
+    "Maximum plans retained in the evaluate() plan cache; beyond it "
+    "the least-recently-used plan is evicted together with every "
+    "compiled variant keyed under it (donation sets, serve batch "
+    "sizes). 0 = unbounded (the pre-serving behavior, and the hot "
+    "path skips the LRU reordering). Eviction counts land on the "
+    "plan_evictions metric.")
+
 _compile_cache: Dict[Tuple, _Exec] = {}
-_plan_cache: Dict[Tuple, _Plan] = {}
+_plan_cache: "OrderedDict[Tuple, _Plan]" = OrderedDict()
 _cache_lock = threading.Lock()
+
+# -- executable-launch serialization -------------------------------------
+#
+# XLA:CPU's intra-process collective rendezvous is NOT safe under
+# concurrent launches: two executables running at once interleave
+# their all-reduce participants on the same device set and deadlock
+# (observed as "waiting for all participants to arrive at rendezvous"
+# stalls). Concurrent evaluate() callers and the serve engine's
+# workers therefore serialize the LAUNCH (not the planning) on
+# backends that need it; TPU launches are queue-serialized per device
+# by PJRT already, so "auto" leaves them unguarded.
+
+_DISPATCH_SERIALIZE_FLAG = FLAGS.define_str(
+    "dispatch_serialize", "auto",
+    "Serialize executable launches across threads: 'auto' (serialize "
+    "on the cpu backend, whose collective rendezvous deadlocks under "
+    "concurrent launches; leave other backends unserialized), 'on', "
+    "or 'off'. Planning, arg gathering and result wrapping always run "
+    "concurrently — only the launch is guarded.")
+
+_launch_lock = threading.Lock()
+_serialize_auto: Optional[bool] = None
+
+
+class _NullLaunchGuard:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullLaunchGuard":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        pass
+
+
+_NULL_GUARD = _NullLaunchGuard()
+_NULL_PHASE = _NullLaunchGuard()  # untimed _wrap_result epilogues
+
+
+def launch_guard():
+    """The launch-serialization context for one executable run; shared
+    by ``_dispatch`` and the serve coalescer. One flag read (+ a
+    cached backend probe under 'auto') on the hot path."""
+    global _serialize_auto
+    v = _DISPATCH_SERIALIZE_FLAG._value
+    if v == "off":
+        return _NULL_GUARD
+    if v != "on":
+        if _serialize_auto is None:
+            _serialize_auto = jax.default_backend() == "cpu"
+        if not _serialize_auto:
+            return _NULL_GUARD
+    return _launch_lock
 
 
 def compile_cache_size() -> int:
@@ -731,6 +842,93 @@ def clear_compile_cache() -> None:
 def clear_plan_cache() -> None:
     with _cache_lock:
         _plan_cache.clear()
+
+
+def lookup_plan(plan_key: Tuple) -> Optional[_Plan]:
+    """Plan-cache read (the ONLY read path — obs/explain and serve/
+    go through here, not the dict). A hit refreshes LRU recency when
+    the cache is bounded; unbounded (plan_cache_max=0) skips the
+    reorder so the legacy hot path is untouched."""
+    with _cache_lock:
+        plan = _plan_cache.get(plan_key)
+        if plan is not None and _PLAN_CACHE_MAX_FLAG._value > 0:
+            _plan_cache.move_to_end(plan_key)
+        return plan
+
+
+def store_plan(plan_key: Tuple, plan: _Plan) -> _Plan:
+    """Plan-cache insert with LRU eviction (FLAGS.plan_cache_max).
+
+    Eviction is donation-variant-aware: the evicted plan's compile
+    signature prefixes every executable compiled FOR it (the donation
+    variants ``plan.key + (donate_key,)`` and the serve coalescer's
+    batch variants ``plan.key + ('serve', B, mode)``), so those leave
+    the compile cache with it — an unbounded per-tenant plan stream
+    cannot pin its dead executables' HBM/host memory. First writer
+    wins on a race (the existing plan is returned)."""
+    evicted = 0
+    with _cache_lock:
+        cur = _plan_cache.get(plan_key)
+        if cur is not None:
+            return cur
+        _plan_cache[plan_key] = plan
+        maxn = _PLAN_CACHE_MAX_FLAG._value
+        while maxn and maxn > 0 and len(_plan_cache) > maxn:
+            _, old = _plan_cache.popitem(last=False)
+            pref, plen = old.key, len(old.key)
+            for ck in [k for k in _compile_cache if k[:plen] == pref]:
+                del _compile_cache[ck]
+            evicted += 1
+    if evicted:
+        prof.count("plan_evictions", evicted)
+    return plan
+
+
+def cached_executable(key: Tuple, make: Callable[[], Callable]) -> _Exec:
+    """Get-or-create a jitted executable in the process compile cache
+    under its locking discipline (``make()`` builds the ``jax.jit``
+    callable on a miss; built outside the lock, first writer wins).
+    The serve coalescer keys its batched variants through here so they
+    share eviction, locking and the compiles metric."""
+    with _cache_lock:
+        ex = _compile_cache.get(key)
+    if ex is None:
+        mine = _Exec(make())
+        with _cache_lock:
+            ex = _compile_cache.setdefault(key, mine)
+        if ex is mine:
+            prof.count("compiles")
+            log_debug("compiled executable key=%s", hash(key))
+    return ex
+
+
+# mesh object -> its plan-key component. Sorting the axis dict costs
+# ~2.5µs per signature; meshes are few and long-lived, so key them by
+# identity (the stored mesh reference keeps the id stable).
+_mesh_keys: Dict[int, Tuple[Any, Tuple]] = {}
+
+
+def _mesh_key(mesh) -> Tuple:
+    hit = _mesh_keys.get(id(mesh))
+    if hit is not None and hit[0] is mesh:
+        return hit[1]
+    key = tuple(sorted(mesh.shape.items()))
+    _mesh_keys[id(mesh)] = (mesh, key)
+    return key
+
+
+def plan_signature(expr: "Expr", mesh=None) -> Tuple[Tuple, "_PlanSigCtx"]:
+    """One raw-DAG traversal -> (plan-cache key, signing context) —
+    exactly what ``evaluate()`` computes before its cache probe. The
+    serve front end signs requests with this at submit time (caller
+    thread) so identical-signature requests can coalesce;
+    ``plan.arg_order`` indexes into ``ctx.leaves``."""
+    if mesh is None:
+        mesh = mesh_mod.get_mesh()
+    rctx = _PlanSigCtx()
+    raw_sig = rctx.of(expr)
+    plan_key = (raw_sig, _opt_flags_key(), _mesh_key(mesh))
+    return plan_key, rctx
 
 
 def _leaf_arg(leaf: Expr) -> Any:
@@ -772,27 +970,46 @@ def _norm_donate(donate: Sequence[Any]) -> List[DistArray]:
     return out
 
 
+# (flag mutation count, pass-registry size) -> flags key. Every
+# plan_signature/evaluate pays this key; re-deriving it walks the
+# FLAGS registry ~10 times (≈20µs — measured 10% of a steady-state
+# signature), so it is memoized on config.mutation_count(), which any
+# flag write bumps. The thread-local degradation rung stays OUT of the
+# memo (appended fresh per call).
+_opt_key_memo: Tuple[Tuple, Tuple] = ((), ())
+_optimize_mod = None  # lazily-bound .optimize (circular import)
+
+
 def _opt_flags_key() -> Tuple:
     """Everything the optimizer stack reads that the raw signature
     cannot see: a plan is only reusable under the exact pass
     configuration that produced it."""
-    from .optimize import _PASSES, _ensure_tiling_pass
+    global _opt_key_memo, _optimize_mod
+    if _optimize_mod is None:  # bind the module once: the per-call
+        import importlib  # `from .optimize import ...` machinery was
+        _optimize_mod = importlib.import_module(  # ~3µs on the
+            ".optimize", __package__)  # per-request signing path
+    _PASSES = _optimize_mod._PASSES
 
     # late-registered passes (smart tiling self-registers on first
     # optimize) must be in the registry BEFORE the key is read, or the
     # very first plan key in a process can never be hit again
-    _ensure_tiling_pass()
-    # audit_numerics changes the LOWERED program (health probes are
-    # compiled in), so audited and plain plans must never share a key;
-    # likewise the OOM degradation rung (resilience/degrade.py) forces
-    # different tilings/passes, so degraded and normal plans are
-    # keyed apart
-    return (tuple(p.name for p in _PASSES if p.enabled()),
-            FLAGS.opt_fold_slices, FLAGS.placement,
-            FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
-            FLAGS.tiling_operand_move_weight,
-            bool(FLAGS.audit_numerics),
-            getattr(degrade_mod._TLS, "rung", None))
+    _optimize_mod._ensure_tiling_pass()
+    ver = (config_mod.mutation_count(), len(_PASSES))
+    memo_ver, key = _opt_key_memo
+    if memo_ver != ver:
+        # audit_numerics changes the LOWERED program (health probes
+        # are compiled in), so audited and plain plans must never
+        # share a key; likewise the OOM degradation rung
+        # (resilience/degrade.py) forces different tilings/passes, so
+        # degraded and normal plans are keyed apart
+        key = (tuple(p.name for p in _PASSES if p.enabled()),
+               FLAGS.opt_fold_slices, FLAGS.placement,
+               FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
+               FLAGS.tiling_operand_move_weight,
+               bool(FLAGS.audit_numerics))
+        _opt_key_memo = (ver, key)
+    return key + (getattr(degrade_mod._TLS, "rung", None),)
 
 
 def _arg_order(raw_leaves: List[Expr],
@@ -822,6 +1039,77 @@ def _arg_order(raw_leaves: List[Expr],
     return tuple(order)
 
 
+def _gather_args(leaves: List[Expr], order: Tuple[int, ...],
+                 donated: List[DistArray]
+                 ) -> Tuple[List[Any], List[DistArray], List[int]]:
+    """Gather executable arguments for one dispatch: the leaf buffers
+    in ``order``, plus the donation bookkeeping — which DistArrays are
+    released (``darrs``) and which argument positions may alias into
+    the outputs (``dpos``). Shared by ``_dispatch`` and the serve
+    coalescer (which gathers per request and never donates)."""
+    ordered = [leaves[i] for i in order]
+    args = [_leaf_arg(leaf) for leaf in ordered]
+
+    darrs: List[DistArray] = []
+    dpos: List[int] = []
+    seen: Dict[int, int] = {}
+    for j, leaf in enumerate(ordered):
+        arr = _leaf_array(leaf)
+        if arr is None:
+            continue
+        if arr._donate_next or any(arr is d for d in donated):
+            if id(arr) in seen:
+                # the same buffer feeds two argument slots: aliasing
+                # it into the output is unsafe, so don't donate
+                # either position (the wrapper is still invalidated
+                # by _wrap_result)
+                k = seen[id(arr)]
+                if k in dpos:
+                    dpos.remove(k)
+                continue
+            seen[id(arr)] = j
+            dpos.append(j)
+            if not any(arr is d for d in darrs):
+                darrs.append(arr)
+    return args, darrs, dpos
+
+
+def _wrap_result(expr: Expr, plan: _Plan, out: Any,
+                 darrs: List[DistArray], dpos: List[int], mesh,
+                 timed: bool = True) -> Any:
+    """Dispatch epilogue: wrap the raw outputs into DistArrays, release
+    donated buffers, update the plan report's donation view, seed the
+    root's result cache, and re-check numerics watchpoints. Shared by
+    ``_dispatch`` and the serve coalescer, which passes ``timed=False``
+    and times ONE build phase around the whole batch instead of paying
+    a span per coalesced request."""
+    ctx = prof.phase("build") if timed else _NULL_PHASE
+    with ctx:
+        if plan.is_tuple:
+            result: Any = tuple(DistArray(o, t, mesh)
+                                for o, t in zip(out, plan.out_tilings))
+        else:
+            result = DistArray(out, plan.out_tilings[0], mesh)
+        for arr in darrs:
+            arr._release_donated()
+        if darrs:
+            prof.count("donated_dispatches")
+        if plan.report is not None:
+            don = plan.report.get("donation")
+            if don is not None:
+                don["last_donated_args"] = sorted(dpos)
+                if darrs:
+                    don["donated_dispatches"] = (
+                        don.get("donated_dispatches", 0) + 1)
+        expr._result = result
+    if numerics_mod._WATCHPOINTS:
+        # persistent data-health watchpoints (st.watch): re-check each
+        # after every dispatch; the empty-list read above is the whole
+        # hot-path cost when none are installed
+        numerics_mod.poll_watchpoints()
+    return result
+
+
 def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
               order: Tuple[int, ...], donated: List[DistArray],
               mesh) -> Any:
@@ -829,44 +1117,13 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
     variant of the executable, execute, wrap, invalidate donated
     buffers, seed the root's result cache."""
     with prof.phase("build"):
-        ordered = [leaves[i] for i in order]
-        args = [_leaf_arg(leaf) for leaf in ordered]
-
-        darrs: List[DistArray] = []
-        dpos: List[int] = []
-        seen: Dict[int, int] = {}
-        for j, leaf in enumerate(ordered):
-            arr = _leaf_array(leaf)
-            if arr is None:
-                continue
-            if arr._donate_next or any(arr is d for d in donated):
-                if id(arr) in seen:
-                    # the same buffer feeds two argument slots: aliasing
-                    # it into the output is unsafe, so don't donate
-                    # either position (the wrapper is still invalidated
-                    # below)
-                    k = seen[id(arr)]
-                    if k in dpos:
-                        dpos.remove(k)
-                    continue
-                seen[id(arr)] = j
-                dpos.append(j)
-                if not any(arr is d for d in darrs):
-                    darrs.append(arr)
+        args, darrs, dpos = _gather_args(leaves, order, donated)
         donate_key = frozenset(dpos)
 
-    with _cache_lock:
-        ex = _compile_cache.get(plan.key + (donate_key,))
-    if ex is None:
-        mine = _Exec(jax.jit(plan.traced,
-                             donate_argnums=tuple(sorted(dpos)))
-                     if dpos else jax.jit(plan.traced))
-        with _cache_lock:
-            ex = _compile_cache.setdefault(plan.key + (donate_key,), mine)
-        if ex is mine:
-            prof.count("compiles")
-            log_debug("compiled expr dag sig=%s donate=%s",
-                      hash(plan.key), sorted(dpos))
+    ex = cached_executable(
+        plan.key + (donate_key,),
+        lambda: (jax.jit(plan.traced, donate_argnums=tuple(sorted(dpos)))
+                 if dpos else jax.jit(plan.traced)))
 
     def run() -> Any:
         with warnings.catch_warnings():
@@ -877,10 +1134,12 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
                     "ignore", message="Some donated buffers were not usable")
             if FLAGS.profile:
                 with jax.profiler.trace(FLAGS.profile_dir):
-                    o = ex.jitted(*args)
+                    with launch_guard():
+                        o = ex.jitted(*args)
                     jax.block_until_ready(o)
                 return o
-            return ex.jitted(*args)
+            with launch_guard():
+                return ex.jitted(*args)
 
     fresh = not ex.warm
     phase_name = "compile" if fresh else "dispatch"
@@ -909,30 +1168,7 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
             if not bool(jnp.all(o1 == o2)):
                 raise AssertionError("nondeterministic evaluation detected")
 
-    with prof.phase("build"):
-        if plan.is_tuple:
-            result: Any = tuple(DistArray(o, t, mesh)
-                                for o, t in zip(out, plan.out_tilings))
-        else:
-            result = DistArray(out, plan.out_tilings[0], mesh)
-        for arr in darrs:
-            arr._release_donated()
-        if darrs:
-            prof.count("donated_dispatches")
-        if plan.report is not None:
-            don = plan.report.get("donation")
-            if don is not None:
-                don["last_donated_args"] = sorted(dpos)
-                if darrs:
-                    don["donated_dispatches"] = (
-                        don.get("donated_dispatches", 0) + 1)
-        expr._result = result
-    if numerics_mod._WATCHPOINTS:
-        # persistent data-health watchpoints (st.watch): re-check each
-        # after every dispatch; the empty-list read above is the whole
-        # hot-path cost when none are installed
-        numerics_mod.poll_watchpoints()
-    return result
+    return _wrap_result(expr, plan, out, darrs, dpos, mesh)
 
 
 _engine_mod = None  # lazily-bound resilience.engine (cold path only)
@@ -989,11 +1225,10 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
                 rctx = _PlanSigCtx()
                 raw_sig = rctx.of(expr)
                 plan_key = (raw_sig, _opt_flags_key(),
-                            tuple(sorted(mesh.shape.items())))
+                            _mesh_key(mesh))
             if FLAGS.trace:  # key_hash re-hashes the signature tuple:
                 esp.set(plan_key=key_hash(plan_key))  # skip when off
-            with _cache_lock:
-                plan = _plan_cache.get(plan_key)
+            plan = lookup_plan(plan_key)
             if plan is not None:
                 prof.count("plan_hits")
                 esp.set(cache="hit")
@@ -1117,8 +1352,7 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
         if raw_order is not None:
             stored = _Plan(key, traced, out_tilings, is_tuple, raw_order,
                            report)
-            with _cache_lock:
-                _plan_cache.setdefault(plan_key, stored)
+            store_plan(plan_key, stored)
         else:
             prof.count("plan_uncacheable")
     return plan, dag, leaves

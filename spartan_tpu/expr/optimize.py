@@ -181,8 +181,15 @@ register_pass(MapFusionPass())
 register_pass(ReduceFusionPass())
 
 
+_tiling_pass_loaded = False
+
+
 def _ensure_tiling_pass() -> None:
+    global _tiling_pass_loaded
+    if _tiling_pass_loaded:  # skip sys.modules machinery on the hot
+        return               # per-signature path
     from . import tiling_pass  # noqa: F401  (self-registers on import)
+    _tiling_pass_loaded = True
 
 
 def optimize(root: Expr, report: Optional[List[Dict]] = None) -> Expr:

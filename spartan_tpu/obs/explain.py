@@ -289,6 +289,13 @@ class ExplainReport:
             for fault in (res.get("faults") or [])[:3]:
                 lines.append(f"    fault [{fault['class']}]: "
                              f"{fault['error']}")
+        sv = d.get("serve")
+        if sv:
+            lines.append(
+                f"  serve: coalesced {sv.get('batches', 0)} batch(es), "
+                f"last batch={sv.get('last_batch')} client(s) "
+                f"[{sv.get('mode')}], {sv.get('requests', 0)} "
+                f"request(s) total")
         ca = d.get("cost_analysis")
         if ca:
             lines.append(
@@ -327,12 +334,8 @@ def explain(expr: Any, cost: bool = True) -> ExplainReport:
         })
 
     mesh = mesh_mod.get_mesh()
-    rctx = base._PlanSigCtx()
-    raw_sig = rctx.of(root)
-    plan_key = (raw_sig, base._opt_flags_key(),
-                tuple(sorted(mesh.shape.items())))
-    with base._cache_lock:
-        plan = base._plan_cache.get(plan_key)
+    plan_key, rctx = base.plan_signature(root, mesh)
+    plan = base.lookup_plan(plan_key)
     status = "hit" if plan is not None else "miss"
     if plan is None:
         plan, dag, _ = base._build_plan(root, mesh, rctx, plan_key)

@@ -45,6 +45,28 @@ FLAGS.define_int(
     "(count/sum/max are exact and unwindowed).")
 
 
+def labeled(name: str, **labels: Any) -> str:
+    """Canonical instrument name carrying Prometheus-style labels:
+    ``labeled("serve_requests", tenant="acme")`` ->
+    ``serve_requests{tenant="acme"}``. Labels are sorted so the same
+    label set always maps to the same instrument, and ``prometheus()``
+    renders the label block natively (one TYPE line per base name).
+    The serve layer keys its per-tenant counters through this."""
+    if not labels:
+        return name
+    body = ",".join(
+        f'{k}="{str(v)}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{body}}}"
+
+
+def split_labels(key: str) -> tuple:
+    """Inverse view of :func:`labeled`: (base name, label block or '')."""
+    i = key.find("{")
+    if i < 0:
+        return key, ""
+    return key[:i], key[i:]
+
+
 class Counter:
     __slots__ = ("name", "help", "_value", "_lock")
 
@@ -201,34 +223,53 @@ class Registry:
         }
 
     def prometheus(self) -> str:
-        """Prometheus text exposition format (0.0.4)."""
+        """Prometheus text exposition format (0.0.4). Instruments named
+        through :func:`labeled` render their label block natively, with
+        one ``# TYPE`` line per base metric (per-tenant serve counters
+        become ``spartan_serve_requests{tenant="..."} N`` series)."""
         lines: List[str] = []
+        typed: set = set()
 
         def _name(raw: str) -> str:
             safe = "".join(ch if (ch.isalnum() or ch == "_") else "_"
                            for ch in raw)
             return "spartan_" + safe
 
+        def _series(raw: str, kind: str) -> str:
+            base, labels = split_labels(raw)
+            n = _name(base)
+            if (n, kind) not in typed:
+                typed.add((n, kind))
+                lines.append(f"# TYPE {n} {kind}")
+            return n + labels
+
         snap = self.snapshot()
         for k in sorted(snap["counters"]):
-            n = _name(k)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {snap['counters'][k]}")
+            lines.append(f"{_series(k, 'counter')} {snap['counters'][k]}")
         for k in sorted(snap["gauges"]):
-            n = _name(k)
             g = snap["gauges"][k]
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {g['value']}")
-            lines.append(f"# TYPE {n}_max gauge")
-            lines.append(f"{n}_max {g['max']}")
+            lines.append(f"{_series(k, 'gauge')} {g['value']}")
+            base, labels = split_labels(k)
+            n = _name(base) + "_max"
+            if (n, "gauge") not in typed:
+                typed.add((n, "gauge"))
+                lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n}{labels} {g['max']}")
         for k in sorted(snap["histograms"]):
-            n = _name(k)
             h = snap["histograms"][k]
-            lines.append(f"# TYPE {n} summary")
-            lines.append(f"{n}{{quantile=\"0.5\"}} {h['p50']}")
-            lines.append(f"{n}{{quantile=\"0.95\"}} {h['p95']}")
-            lines.append(f"{n}_sum {h['sum']}")
-            lines.append(f"{n}_count {h['count']}")
+            base, labels = split_labels(k)
+            n = _name(base)
+            if (n, "summary") not in typed:
+                typed.add((n, "summary"))
+                lines.append(f"# TYPE {n} summary")
+            q1 = labels[:-1] + ',quantile="0.5"}' if labels else \
+                '{quantile="0.5"}'
+            q2 = labels[:-1] + ',quantile="0.95"}' if labels else \
+                '{quantile="0.95"}'
+            lines.append(f"{n}{q1} {h['p50']}")
+            lines.append(f"{n}{q2} {h['p95']}")
+            lines.append(f"{n}_sum{labels} {h['sum']}")
+            lines.append(f"{n}_count{labels} {h['count']}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

@@ -484,14 +484,14 @@ def audit(expr: Any, donate: Sequence[Any] = ()) -> AuditReport:
     prev = _AUDIT_FLAG._value
     with _lock:
         _collectors.append(coll)
-    _AUDIT_FLAG._value = True
-    try:
+    _AUDIT_FLAG.value = True  # via the setter: bumps the flag
+    try:                      # mutation counter plan keys memoize on
         with trace_mod.span("audit",
                             root=f"{type(root).__name__}#{root._id}"):
             result = base.evaluate(root, donate=donate)
             _flush_effects(result)
     finally:
-        _AUDIT_FLAG._value = prev
+        _AUDIT_FLAG.value = prev
         with _lock:
             _collectors.remove(coll)
     if coll.guards:
@@ -723,12 +723,42 @@ class _Watchdog:
             self.timer.cancel()
 
 
+class deadline_scope:
+    """Thread-local watchdog tightening for one request: inside the
+    scope, :func:`watchdog` arms at ``min(FLAGS.dispatch_timeout_s,
+    seconds)`` — the serve engine propagates each request's remaining
+    deadline into the PR-4 watchdog this way, so a dispatch that will
+    blow its caller's deadline dumps in-flight forensics even when the
+    global timeout is generous (or off). ``seconds=None`` is a no-op
+    scope (the common no-deadline request)."""
+
+    __slots__ = ("seconds", "_prev")
+
+    def __init__(self, seconds: Optional[float]):
+        self.seconds = seconds
+        self._prev: Optional[float] = None
+
+    def __enter__(self) -> "deadline_scope":
+        if self.seconds is not None:
+            self._prev = getattr(_tls, "deadline_s", None)
+            _tls.deadline_s = max(1e-3, float(self.seconds))
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self.seconds is not None:
+            _tls.deadline_s = self._prev
+
+
 def watchdog(label: str,
              report: Optional[Dict[str, Any]] = None) -> Any:
     """Watchdog context for one dispatch; a shared no-op when
-    ``FLAGS.dispatch_timeout_s`` <= 0 (one float read on the hot
+    ``FLAGS.dispatch_timeout_s`` <= 0 and no :class:`deadline_scope`
+    is active (one float read + one thread-local getattr on the hot
     path)."""
     t = _TIMEOUT_FLAG._value
+    d = getattr(_tls, "deadline_s", None)
+    if d is not None:
+        t = min(t, d) if t and t > 0 else d
     if not t or t <= 0:
         return _NULL_WD
     return _Watchdog(label, report, float(t))
@@ -772,6 +802,11 @@ def dump_crash(path: Optional[str] = None, reason: str = "",
     doc: Dict[str, Any] = {
         "reason": reason,
         "pid": os.getpid(),
+        # the non-default FLAGS in force when the process died: lets a
+        # post-mortem attribute a regression/hang to a flag default
+        # (ROADMAP r05 cold-start suspicion) without re-running
+        "flags_nondefault": {f.name: f.value for f in FLAGS
+                             if f.value != f.default},
         "inflight_spans": trace_mod.inflight(),
         "recent_spans": recent,
         "last_health": last_health(),

@@ -29,12 +29,13 @@ forensics (the PR-4 crash-dump machinery) before re-raising.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs.explain import key_hash
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
-from ..obs.metrics import REGISTRY
+from ..obs.metrics import REGISTRY, labeled
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from ..utils.log import log_warn
@@ -64,17 +65,61 @@ FLAGS.define_bool(
     "retry + OOM degradation). Off = dispatch failures propagate "
     "raw, as before PR 5.")
 
+FLAGS.define_int(
+    "serve_tenant_retry_quota", 0,
+    "Tenant-wide lifetime retry quota across ALL plans (the serve "
+    "engine's admission tier on top of the per-plan retry_budget): a "
+    "tenant whose requests keep failing transiently stops consuming "
+    "retries once the quota is spent, independent of which plans it "
+    "submits. 0 = disabled (per-plan budgets only).")
+
 # deterministic jitter source (reproducible test timing, and
 # Math.random-free: the sequence does not depend on import order)
 _rng = random.Random(0xC0FFEE)
 
-# plan digest -> retries consumed (lifetime budget bookkeeping)
+# (tenant, plan digest) -> retries consumed, plus tenant-wide totals.
+# Budgets are shared hot state under concurrent serving: every
+# mutation happens under _budget_lock (never held while dispatching).
+_budget_lock = threading.Lock()
 _budget_used: Dict[str, int] = {}
+_tenant_used: Dict[str, int] = {}
+
+# the serve engine tags its worker thread with the request's tenant so
+# budget charging lands on the right account; None = untenanted caller
+_TENANT_TLS = threading.local()
+
+
+class tenant_scope:
+    """Tag the current thread's failures with a tenant: retry budgets
+    consumed inside the scope charge ``<tenant>/<plan digest>`` (and
+    the tenant-wide ``FLAGS.serve_tenant_retry_quota``) instead of the
+    shared per-plan account — one tenant's fault storm cannot exhaust
+    another tenant's retries on the same plan."""
+
+    __slots__ = ("tenant", "_prev")
+
+    def __init__(self, tenant: Optional[str]):
+        self.tenant = tenant
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> "tenant_scope":
+        self._prev = getattr(_TENANT_TLS, "tenant", None)
+        _TENANT_TLS.tenant = self.tenant
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _TENANT_TLS.tenant = self._prev
+
+
+def current_tenant() -> Optional[str]:
+    return getattr(_TENANT_TLS, "tenant", None)
 
 
 def reset() -> None:
-    """Forget per-plan retry budgets (test isolation)."""
-    _budget_used.clear()
+    """Forget per-plan and per-tenant retry budgets (test isolation)."""
+    with _budget_lock:
+        _budget_used.clear()
+        _tenant_used.clear()
 
 
 def _attach_note(exc: BaseException, note: str) -> None:
@@ -182,19 +227,33 @@ def handle_failure(exc: BaseException, expr: Any, plan: Any,
             raise exc
         from ..expr import base
 
+        tenant = current_tenant()
         digest = _plan_digest(plan)
+        account = f"{tenant}/{digest}" if tenant else digest
         attempt = 0
         last = exc
         while attempt < FLAGS.retry_max:
-            used = _budget_used.get(digest, 0)
-            if used >= FLAGS.retry_budget:
-                _attach_note(
-                    last, f"resilience: per-plan retry budget "
-                    f"({FLAGS.retry_budget}) exhausted for plan "
-                    f"{digest}")
+            exhausted: Optional[str] = None
+            with _budget_lock:
+                used = _budget_used.get(account, 0)
+                quota = FLAGS.serve_tenant_retry_quota
+                if used >= FLAGS.retry_budget:
+                    exhausted = (f"per-plan retry budget "
+                                 f"({FLAGS.retry_budget}) exhausted "
+                                 f"for {account}")
+                elif (tenant and quota > 0
+                        and _tenant_used.get(tenant, 0) >= quota):
+                    exhausted = (f"tenant retry quota ({quota}) "
+                                 f"exhausted for tenant {tenant!r}")
+                else:
+                    _budget_used[account] = used + 1
+                    if tenant:
+                        _tenant_used[tenant] = (
+                            _tenant_used.get(tenant, 0) + 1)
+            if exhausted is not None:
+                _attach_note(last, "resilience: " + exhausted)
                 _dump("retry budget exhausted", plan, rec)
                 raise last
-            _budget_used[digest] = used + 1
             delay = _sleep_backoff(attempt)
             rec["retries"] += 1
             if _METRICS_FLAG._value:
@@ -202,6 +261,10 @@ def handle_failure(exc: BaseException, expr: Any, plan: Any,
                     "resilience_retries",
                     "dispatch retries attempted by the policy "
                     "engine").inc()
+                if tenant:
+                    REGISTRY.counter(
+                        labeled("resilience_retries", tenant=tenant),
+                        "per-tenant dispatch retries (serve)").inc()
             with prof.span("retry", attempt=attempt, plan=digest,
                            error_class=kind,
                            backoff_ms=round(delay * 1e3, 1)) as rsp:

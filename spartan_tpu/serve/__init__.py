@@ -1,0 +1,54 @@
+"""Concurrent serving front end: async evaluate, admission control,
+request coalescing.
+
+The north star demands many concurrent callers; ``evaluate()`` is a
+synchronous single-caller path. This package puts a serving engine in
+front of the existing plan machinery — no new execution semantics,
+just concurrency:
+
+* **async evaluation** — ``st.evaluate_async(expr)`` /
+  ``expr.evaluate_async()`` return an :class:`EvalFuture` immediately;
+  a worker dispatches and the future resolves with the (async-device)
+  ``DistArray``. Fetch (``.glom()``) is where execution is awaited.
+* **admission control** — a bounded queue; past the high-water mark
+  submissions are rejected with :class:`Backpressure` carrying a
+  ``retry_after_s`` estimate. Deadlines shed expired requests and
+  propagate into the dispatch watchdog.
+* **signature-level coalescing** — requests whose raw-DAG signature
+  matches (the PR-1 plan-cache key) within the batching window share
+  one cached plan and batch along a new leading client axis: one
+  compile, one dispatch, N responses (the DrJAX vmap-over-clients
+  construction). ``st.explain`` names the coalesced batch.
+* **tenancy** — per-tenant request counters in the Prometheus export
+  and per-tenant retry budgets in the resilience engine.
+
+Locking discipline (the concurrency contract of the whole hot path;
+see also expr/base.py's shared-state comment):
+
+* ``expr/base._cache_lock`` guards the plan + compile caches; held for
+  dict ops only, accessed ONLY through ``lookup_plan`` /
+  ``store_plan`` / ``cached_executable`` (lint rule 6).
+* the metrics registry, trace ring, chaos plan, retry budgets and the
+  coalescer's mode table each take their own leaf lock; no module
+  calls out of itself while holding one, so the lock graph is acyclic.
+* per-request state (tenant, deadline) rides thread-locals
+  (``resilience.engine.tenant_scope``, ``obs.numerics.deadline_scope``)
+  set by the worker around each dispatch.
+* futures are resolved exactly once by their owning worker; callers
+  only wait on an Event.
+
+See docs/SERVING.md for the full queue/backpressure/coalescing
+contract and benchmarks/serving_latency.py for the acceptance gates.
+"""
+
+from .coalesce import reset_modes
+from .engine import (ServeEngine, default_engine, evaluate_async,
+                     shutdown_default)
+from .future import Backpressure, DeadlineExceeded, EvalFuture, ServeError
+from .queue import AdmissionQueue
+
+__all__ = [
+    "ServeEngine", "AdmissionQueue", "EvalFuture", "ServeError",
+    "Backpressure", "DeadlineExceeded", "evaluate_async",
+    "default_engine", "shutdown_default", "reset_modes",
+]

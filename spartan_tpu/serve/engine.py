@@ -1,0 +1,385 @@
+"""The concurrent serving engine: workers, batching window, dispatch.
+
+One :class:`ServeEngine` owns a bounded :class:`AdmissionQueue` and a
+small pool of worker threads. The request lifecycle:
+
+1. **submit** (caller thread): normalize donation, capture the ambient
+   mesh, sign the raw DAG once (``base.plan_signature`` — the same
+   traversal ``evaluate()`` would do), then enqueue. Admission past
+   the high-water mark raises ``Backpressure(retry_after_s=...)``
+   instead of queueing unbounded latency.
+2. **batch** (worker): pop the head request, pull every queued request
+   with the same plan signature, linger one batching window
+   (``FLAGS.serve_batch_window_s``) for stragglers, and re-pull.
+3. **dispatch**: a batch of one (or a donating / uncacheable-plan /
+   unknown-plan request) goes through plain ``evaluate()`` under the
+   request's tenant scope + deadline scope; a batch of N goes through
+   the coalescer (one compile, one dispatch, N responses). A failed
+   coalesced dispatch falls back to solo dispatches, where the
+   resilience policy engine applies classification, per-tenant retry
+   budgets and backoff per request.
+4. **resolve**: each request's future resolves with its DistArray
+   (device execution may still be in flight — fetch blocks); donated
+   buffers were invalidated by the dispatch epilogue.
+
+Deadlines: a request whose deadline expires in the queue is shed with
+``DeadlineExceeded`` (never dispatched); the remaining time of a live
+request propagates into the PR-4 dispatch watchdog
+(``obs/numerics.deadline_scope``), so a dispatch that would blow the
+deadline dumps in-flight forensics.
+
+Tenancy: ``tenant=`` labels flow into per-tenant metrics
+(``serve_requests{tenant="..."}`` in the Prometheus export) and into
+the resilience engine's per-tenant retry accounts
+(``engine.tenant_scope``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..expr import base
+from ..obs import numerics as numerics_mod
+from ..obs import trace as trace_mod
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY, labeled
+from ..parallel import mesh as mesh_mod
+from ..resilience import engine as resilience_engine
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
+from ..utils.log import log_warn
+from . import coalesce
+from .future import DeadlineExceeded, EvalFuture
+from .queue import AdmissionQueue
+
+FLAGS.define_int(
+    "serve_workers", 2,
+    "Worker threads in the default serve engine's dispatch pool.")
+FLAGS.define_int(
+    "serve_queue_max", 1024,
+    "Admission-control high-water mark: submissions past this queue "
+    "depth are rejected with Backpressure(retry_after_s=...) instead "
+    "of queueing unbounded latency.")
+FLAGS.define_float(
+    "serve_batch_window_s", 0.002,
+    "Coalescing linger: after popping a request, a worker waits up to "
+    "this long for more identical-signature submissions before "
+    "dispatching the batch. 0 = dispatch immediately (coalesce only "
+    "what is already queued).")
+FLAGS.define_int(
+    "serve_max_batch", 32,
+    "Maximum clients coalesced into one batched dispatch (the batch "
+    "size is part of the compile-cache key; a new size compiles a new "
+    "variant).")
+FLAGS.define_bool(
+    "serve_coalesce", True,
+    "Coalesce identical-signature requests into leading-axis batched "
+    "dispatches (one compile, one dispatch, N responses). Off = every "
+    "request dispatches solo (still async, still admission-controlled).")
+
+
+def _pow2_chunks(batch: List["_Request"]) -> List[List["_Request"]]:
+    """Split a batch into largest-power-of-two-first chunks."""
+    out: List[List["_Request"]] = []
+    i = 0
+    while i < len(batch):
+        size = 1 << ((len(batch) - i).bit_length() - 1)
+        out.append(batch[i:i + size])
+        i += size
+    return out
+
+
+class _Request:
+    """One queued evaluation. Signed at submit time (caller thread) so
+    workers can group by plan signature without re-traversing."""
+
+    __slots__ = ("expr", "donate", "tenant", "deadline", "future",
+                 "plan_key", "leaves", "mesh", "coalescable",
+                 "t_submit", "taken")
+
+    def __init__(self, expr: Any, donate: List[Any],
+                 tenant: Optional[str], deadline_s: Optional[float],
+                 mesh) -> None:
+        self.expr = expr
+        self.donate = donate
+        self.tenant = tenant
+        self.t_submit = trace_mod.now()
+        self.deadline = (self.t_submit + deadline_s
+                         if deadline_s is not None else None)
+        self.future = EvalFuture(tenant)
+        self.future.t_submit = self.t_submit
+        self.mesh = mesh
+        self.taken = False  # queue bookkeeping (AdmissionQueue)
+        self.plan_key, sig_ctx = base.plan_signature(expr, mesh)
+        self.leaves = sig_ctx.leaves
+        # donating requests never coalesce: buffer aliasing is a
+        # per-dispatch contract the batched program cannot honor
+        self.coalescable = (not donate and not any(
+            arr is not None and arr._donate_next
+            for arr in (base._leaf_array(l) for l in self.leaves)))
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - trace_mod.now()
+
+
+class ServeEngine:
+    """A worker pool + admission queue + coalescer. Usable as a
+    context manager; ``stop()`` drains (rejects) the backlog."""
+
+    def __init__(self, workers: Optional[int] = None,
+                 queue_max: Optional[int] = None,
+                 batch_window_s: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 coalesce_requests: Optional[bool] = None):
+        self.workers = int(workers if workers is not None
+                           else FLAGS.serve_workers)
+        self.batch_window_s = float(
+            batch_window_s if batch_window_s is not None
+            else FLAGS.serve_batch_window_s)
+        self.max_batch = int(max_batch if max_batch is not None
+                             else FLAGS.serve_max_batch)
+        self.coalesce_requests = bool(
+            coalesce_requests if coalesce_requests is not None
+            else FLAGS.serve_coalesce)
+        self.queue = AdmissionQueue(
+            queue_max if queue_max is not None else FLAGS.serve_queue_max)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return bool(self._threads) and not self._stop.is_set()
+
+    def start(self) -> "ServeEngine":
+        with self._lock:
+            if self._threads:
+                return self
+            self._stop.clear()
+            self.queue.reopen()
+            for i in range(max(1, self.workers)):
+                t = threading.Thread(
+                    target=self._worker, name=f"spartan-serve-{i}",
+                    daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            threads, self._threads = self._threads, []
+        self._stop.set()
+        self.queue.close()  # wakes idle workers blocked on the CV
+        for r in self.queue.drain():
+            r.future._reject(RuntimeError("serve engine stopped"))
+        for t in threads:
+            t.join(timeout)
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, expr: Any, donate: Sequence[Any] = (),
+               tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> EvalFuture:
+        """Admit one evaluation; returns its future immediately.
+        Raises :class:`Backpressure` past the queue's high-water mark."""
+        expr = base.as_expr(expr)
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "serve_requests", "requests submitted to the serve "
+                "engine").inc()
+            if tenant:
+                REGISTRY.counter(
+                    labeled("serve_requests", tenant=tenant),
+                    "per-tenant submissions").inc()
+        if expr._result is not None:  # already evaluated: no dispatch
+            fut = EvalFuture(tenant)
+            fut.t_submit = trace_mod.now()
+            fut._resolve(expr._result)
+            return fut
+        donated = base._norm_donate(donate)
+        req = _Request(expr, donated, tenant, deadline_s,
+                       mesh_mod.get_mesh())
+        if not self.running:
+            self.start()
+        self.queue.put(req, workers=self.workers)
+        return req.future
+
+    def stats(self) -> Dict[str, Any]:
+        c = REGISTRY.counter_values()
+        total = c.get("serve_requests", 0)
+        coal = c.get("serve_coalesced_requests", 0)
+        return {
+            "queue_depth": self.queue.depth(),
+            "requests": total,
+            "coalesced_requests": coal,
+            "coalesced_batches": c.get("serve_coalesced_batches", 0),
+            "rejected": c.get("serve_rejected", 0),
+            "deadline_expired": c.get("serve_deadline_expired", 0),
+            "solo_fallbacks": c.get("serve_solo_fallbacks", 0),
+            "coalesce_hit_ratio": (coal / total) if total else 0.0,
+        }
+
+    # -- worker side ----------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            # blocking pop: an idle worker parks on the queue's CV and
+            # costs zero CPU until a put or close() wakes it
+            req = self.queue.pop()
+            if req is None:
+                continue
+            with prof.stopwatch() as sw:
+                try:
+                    self._service(req)
+                except Exception as e:  # belt: _service resolves futures
+                    req.future._reject(e)
+            self.queue.note_service_time(sw.elapsed)
+
+    def _shed_expired(self, batch: List[_Request]) -> List[_Request]:
+        live: List[_Request] = []
+        for r in batch:
+            rem = r.remaining_s()
+            if rem is not None and rem <= 0:
+                if _METRICS_FLAG._value:
+                    REGISTRY.counter(
+                        "serve_deadline_expired",
+                        "requests shed because their deadline expired "
+                        "before dispatch").inc()
+                r.future._reject(DeadlineExceeded(
+                    f"deadline expired {-rem * 1e3:.1f}ms before "
+                    f"dispatch (queued {trace_mod.now() - r.t_submit:.3f}s)"))
+            else:
+                live.append(r)
+        return live
+
+    def _service(self, req: _Request) -> None:
+        batch = [req]
+        if self.coalesce_requests and req.coalescable:
+            batch += self.queue.take_matching(
+                req.plan_key, self.max_batch - len(batch))
+            if len(batch) < self.max_batch and self.batch_window_s > 0:
+                # linger once for stragglers inside the batching window
+                self.queue.wait_for_more(self.batch_window_s)
+                batch += self.queue.take_matching(
+                    req.plan_key, self.max_batch - len(batch))
+        batch = self._shed_expired(batch)
+        if not batch:
+            return
+
+        if len(batch) == 1 or not self.coalesce_requests:
+            for r in batch:
+                self._solo(r)
+            return
+
+        plan = base.lookup_plan(req.plan_key)
+        if plan is None:
+            # plan-cache miss: build it by evaluating the head request
+            # solo (optimize + compile once), then coalesce the rest
+            self._solo(batch[0])
+            batch = self._shed_expired(batch[1:])
+            plan = base.lookup_plan(req.plan_key)
+        if not batch:
+            return
+        if (plan is None or plan.arg_order is None
+                or coalesce.mode_for(plan) == "off" or len(batch) == 1):
+            # uncacheable plan / demoted plan / single survivor
+            for r in batch:
+                self._solo(r)
+            return
+        # quantize to power-of-two chunks (13 -> 8+4+1): the batch size
+        # is part of the compile-cache key, so free-running sizes would
+        # compile a variant per observed size — quantized, a plan gains
+        # at most log2(serve_max_batch) batched variants ever
+        for chunk in _pow2_chunks(batch):
+            if len(chunk) == 1:
+                self._solo(chunk[0])
+                continue
+            try:
+                self._coalesced(plan, chunk)
+            except Exception as e:
+                mode = coalesce.classify_batch_failure(e, plan)
+                if _METRICS_FLAG._value:
+                    REGISTRY.counter(
+                        "serve_solo_fallbacks",
+                        "batches that fell back to solo dispatches "
+                        "after a batched failure").inc()
+                log_warn("serve: coalesced dispatch failed (%s: %s); "
+                         "falling back to %d solo dispatch(es), "
+                         "mode=%s", type(e).__name__, str(e)[:120],
+                         len(chunk), mode)
+                for r in chunk:
+                    self._solo(r)
+
+    def _coalesced(self, plan: Any, batch: List[_Request]) -> None:
+        deadlines = [r.remaining_s() for r in batch]
+        tightest = min((d for d in deadlines if d is not None),
+                       default=None)
+        with mesh_mod.use_mesh(batch[0].mesh), \
+                numerics_mod.deadline_scope(tightest):
+            results = coalesce.dispatch_batch(plan, batch, batch[0].mesh)
+        for r, res in zip(batch, results):
+            r.future.coalesced = len(batch)
+            r.future._resolve(res)
+
+    def _solo(self, r: _Request) -> None:
+        with mesh_mod.use_mesh(r.mesh), \
+                resilience_engine.tenant_scope(r.tenant), \
+                numerics_mod.deadline_scope(r.remaining_s()):
+            try:
+                result = base.evaluate(r.expr, donate=r.donate)
+            except Exception as e:
+                # the resilience engine already ran (classified,
+                # retried under the tenant's budget); hand the terminal
+                # failure to the caller through its future
+                r.future._reject(e)
+                return
+        r.future.coalesced = 1
+        r.future._resolve(result)
+
+
+# -- the default engine (st.evaluate_async) ------------------------------
+
+_default_lock = threading.Lock()
+_default: Optional[ServeEngine] = None
+
+
+def default_engine() -> ServeEngine:
+    """The process's shared engine, started lazily on first use."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ServeEngine()
+        return _default.start()
+
+
+def shutdown_default() -> None:
+    """Stop (and forget) the default engine; the next
+    ``evaluate_async`` starts a fresh one."""
+    global _default
+    with _default_lock:
+        eng, _default = _default, None
+    if eng is not None:
+        eng.stop()
+
+
+def evaluate_async(expr: Any, donate: Sequence[Any] = (),
+                   tenant: Optional[str] = None,
+                   deadline_s: Optional[float] = None) -> EvalFuture:
+    """Submit ``expr`` to the default serve engine: returns an
+    :class:`EvalFuture` immediately. Identical-signature requests from
+    concurrent callers coalesce into one batched dispatch; the
+    resilience engine's retries and the dispatch watchdog apply per
+    request. See docs/SERVING.md."""
+    return default_engine().submit(expr, donate=donate, tenant=tenant,
+                                   deadline_s=deadline_s)

@@ -1,0 +1,154 @@
+"""Request futures + the serving error vocabulary.
+
+An :class:`EvalFuture` is the handle ``evaluate_async`` returns: the
+submitting thread gets it immediately, a serve worker resolves it after
+the (possibly coalesced) dispatch. Resolution happens at dispatch
+completion — JAX execution is asynchronous, so the resolved
+``DistArray`` is an in-flight device handle and only a fetch
+(``.glom()``) blocks on the actual computation; donated input buffers
+are invalidated at the same resolution point (the serving analogue of
+``evaluate()``'s dispatch epilogue).
+
+Thread-safety: one ``threading.Event`` per future; ``_resolve`` /
+``_reject`` are called exactly once by the owning worker (double
+resolution is ignored, first writer wins), callbacks run on the
+resolving thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class Backpressure(ServeError):
+    """Admission control rejected the request: the submission queue is
+    past its high-water mark. ``retry_after_s`` is the engine's
+    estimate of when capacity frees up (queue depth x recent service
+    time per worker) — the reject-with-retry-after contract clients
+    are expected to honor instead of hammering the queue."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"serve queue full ({depth} request(s) queued); "
+            f"retry after ~{retry_after_s:.3f}s")
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired before its dispatch started (it
+    was shed from the queue) or before its result resolved."""
+
+
+class EvalFuture:
+    """Resolution handle for one submitted evaluation.
+
+    ``result(timeout)`` blocks until the worker resolves the future and
+    returns the ``DistArray`` (or tuple, for ``TupleExpr`` roots) — or
+    raises the failure the evaluation produced (after the resilience
+    engine's retries ran their course). ``glom(timeout)`` additionally
+    fetches to the host, which is where asynchronous device execution
+    is actually awaited."""
+
+    __slots__ = ("_event", "_result", "_exc", "_callbacks", "_lock",
+                 "tenant", "coalesced", "t_submit", "t_resolved")
+
+    def __init__(self, tenant: Optional[str] = None):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self._callbacks: List[Callable[["EvalFuture"], None]] = []
+        self._lock = threading.Lock()
+        self.tenant = tenant
+        # set by the worker: how many requests shared this dispatch
+        # (1 = solo); observability for tests and clients
+        self.coalesced = 0
+        # engine-stamped tracer-clock timestamps (obs.trace.now):
+        # t_resolved - t_submit is the request's serving latency
+        self.t_submit: float = 0.0
+        self.t_resolved: float = 0.0
+
+    # -- caller side ----------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"EvalFuture.result timed out after {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"EvalFuture.exception timed out after {timeout}s")
+        return self._exc
+
+    def glom(self, timeout: Optional[float] = None) -> Any:
+        """Resolve AND fetch: the one call that blocks on device
+        execution (``result()`` returns an async array handle)."""
+        out = self.result(timeout)
+        if isinstance(out, tuple):
+            return tuple(o.glom() for o in out)
+        return out.glom()
+
+    def add_done_callback(self, fn: Callable[["EvalFuture"], None]
+                          ) -> None:
+        """Run ``fn(self)`` when the future resolves (immediately if it
+        already has). Runs on the resolving worker thread; exceptions
+        from callbacks are swallowed (a client callback must not kill
+        a worker)."""
+        run_now = False
+        with self._lock:
+            if self._event.is_set():
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            try:
+                fn(self)
+            except Exception:
+                pass
+
+    # -- worker side ----------------------------------------------------
+
+    def _fire_callbacks(self) -> None:
+        with self._lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:
+                pass  # client callbacks must not kill the worker
+
+    def _stamp(self) -> None:
+        from ..obs import trace as trace_mod
+
+        self.t_resolved = trace_mod.now()
+
+    def _resolve(self, result: Any) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._result = result
+            self._stamp()
+            self._event.set()
+        self._fire_callbacks()
+
+    def _reject(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._exc = exc
+            self._stamp()
+            self._event.set()
+        self._fire_callbacks()

@@ -16,6 +16,23 @@ import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 
+# Global flag-mutation counter: bumped on every value change (set /
+# parse / reset) so hot paths can memoize flag-derived keys (e.g.
+# expr/base._opt_flags_key) and invalidate on ANY flag write instead
+# of re-reading the registry per call. Monotonic; reads are unlocked
+# (a stale read just recomputes once).
+_mutations = 0
+
+
+def mutation_count() -> int:
+    return _mutations
+
+
+def _bump() -> None:
+    global _mutations
+    _mutations += 1
+
+
 class Flag:
     """A single typed flag with a default and an env-var override."""
 
@@ -40,12 +57,15 @@ class Flag:
     @value.setter
     def value(self, v: Any) -> None:
         self._value = v
+        _bump()
 
     def parse(self, text: str) -> None:
         self._value = self.parser(text)
+        _bump()
 
     def reset(self) -> None:
         self._value = self._initial
+        _bump()
 
 
 def _parse_bool(text: str) -> bool:
@@ -138,6 +158,14 @@ class FlagRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         return {f.name: f.value for f in self._flags.values()}
+
+    def snapshot_nondefault(self) -> Dict[str, Any]:
+        """Flags whose value differs from the compiled-in default —
+        the compact attribution record every committed benchmark
+        carries (a BENCH_r05 TPU regression must be attributable to
+        flag state vs compile-cache growth without rerunning)."""
+        return {f.name: f.value for f in self._flags.values()
+                if f.value != f.default}
 
 
 FLAGS = FlagRegistry()

@@ -172,3 +172,45 @@ def test_bare_recovery_allows_engine_route_and_resilience_dir():
         "except Exception:\n"
         "    x = None\n")
     assert lint_repo.lint_bare_recovery("/x/y.py", unrelated) == []
+
+
+def test_catches_shared_state_access(tmp_path):
+    bad = tmp_path / "rogue.py"
+    bad.write_text(
+        "from spartan_tpu.expr import base\n"
+        "base._plan_cache.clear()\n"
+        "x = base._compile_cache\n"
+        "with base._cache_lock:\n"
+        "    pass\n"
+        "from spartan_tpu.obs.metrics import REGISTRY\n"
+        "REGISTRY._counters['hacked'] = 1\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_shared_state(str(bad), tree)
+    assert sum(f.rule == "shared-state" for f in findings) == 4
+    # ... and the remedy names the sanctioned accessors
+    assert any("lookup_plan" in f.message for f in findings)
+    assert any("REGISTRY.counter()" in f.message for f in findings)
+
+
+def test_shared_state_allowed_in_owners():
+    # the owning modules ARE the locking discipline; each may touch
+    # its own tables (and only its own — expr/base must still go
+    # through the registry API and vice versa)
+    for rel in (os.path.join("spartan_tpu", "expr", "base.py"),
+                os.path.join("spartan_tpu", "obs", "metrics.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        assert lint_repo.lint_shared_state(path, tree) == []
+
+
+def test_shared_state_accessor_use_is_clean(tmp_path):
+    ok = tmp_path / "client.py"
+    ok.write_text(
+        "from spartan_tpu.expr.base import lookup_plan, store_plan\n"
+        "from spartan_tpu.obs.metrics import REGISTRY\n"
+        "plan = lookup_plan(('key',))\n"
+        "REGISTRY.counter('serve_requests').inc()\n"
+        "REGISTRY.gauge('serve_queue_depth').set(3)\n")
+    tree = ast.parse(ok.read_text(), filename=str(ok))
+    assert lint_repo.lint_shared_state(str(ok), tree) == []

@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Four repo-specific rules that generic linters cannot know:
+Six repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -40,10 +40,24 @@ Four repo-specific rules that generic linters cannot know:
    exactly the blind-retry bug class the classifier + policy engine
    replaced — it retries deterministic errors, bypasses the per-plan
    retry budget, and its failures are invisible to the
-   ``resilience_*`` metrics and crash-dump forensics. The one
-   sanctioned shape outside ``resilience/`` is a handler that routes
-   straight into the engine (calls ``handle_failure``), which is how
-   ``expr/base.evaluate`` wires the boundary.
+   ``resilience_*`` metrics and crash-dump forensics. The TWO
+   sanctioned shapes outside ``resilience/`` are a handler that routes
+   straight into the engine (calls ``handle_failure``) — how
+   ``expr/base.evaluate`` wires the boundary — and a handler that
+   hands the classified, already-retried failure to its caller
+   through a serve future (calls ``_reject`` / ``set_exception``) —
+   how ``serve/engine`` wires the worker boundary. Neither retries.
+
+6. No direct access to the shared evaluation caches
+   (``_plan_cache`` / ``_compile_cache`` / ``_cache_lock``) outside
+   ``spartan_tpu/expr/base.py``, and none to the metrics registry's
+   internal tables (``_counters`` / ``_gauges`` / ``_hists``) outside
+   ``spartan_tpu/obs/metrics.py`` (the concurrent-serving PR): these
+   are hot SHARED state with a documented locking discipline, and a
+   bare dict poke from another module bypasses the lock, the LRU
+   recency order and the eviction accounting. Go through the
+   accessors (``lookup_plan`` / ``store_plan`` / ``cached_executable``
+   / ``clear_*``; ``REGISTRY.counter()/gauge()/histogram()``).
 
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
@@ -87,9 +101,19 @@ _RECOVERY_ALLOWED_DIRS = (os.path.join("spartan_tpu", "resilience")
                           + os.sep,)
 _BROAD_HANDLERS = {"Exception", "BaseException", "RuntimeError"}
 _DISPATCH_CALLS = {"evaluate", "force", "recompute", "_dispatch", "jit"}
-# a handler that immediately routes into the policy engine is the
-# sanctioned boundary shape (expr/base.evaluate)
-_ENGINE_ROUTES = {"handle_failure", "_handle_failure"}
+# a handler that immediately routes into the policy engine
+# (expr/base.evaluate) or hands the terminal failure to the caller
+# through a serve future (serve/engine workers) is a sanctioned
+# boundary shape — neither retries
+_ENGINE_ROUTES = {"handle_failure", "_handle_failure",
+                  "_reject", "set_exception"}
+
+# rule 6: owners of the hot shared state; everyone else goes through
+# the accessors so locking/LRU/eviction stay in one place
+_CACHE_NAMES = {"_plan_cache", "_compile_cache", "_cache_lock"}
+_CACHE_OWNER = os.path.join("spartan_tpu", "expr", "base.py")
+_REGISTRY_INTERNALS = {"_counters", "_gauges", "_hists"}
+_METRICS_OWNER = os.path.join("spartan_tpu", "obs", "metrics.py")
 
 
 class Finding:
@@ -292,6 +316,42 @@ def lint_bare_recovery(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_shared_state(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 6: the plan/compile caches and the metrics registry's
+    internal tables are touched only by their owning modules — any
+    other access bypasses the locking discipline, the LRU recency
+    order and the eviction accounting the serving engine relies on."""
+    rel = os.path.relpath(path, REPO)
+    cache_owner = rel == _CACHE_OWNER
+    metrics_owner = rel == _METRICS_OWNER
+    findings: List[Finding] = []
+
+    def check(node: ast.AST, name: str) -> None:
+        if name in _CACHE_NAMES and not cache_owner:
+            findings.append(Finding(
+                path, getattr(node, "lineno", 0), "shared-state",
+                f"direct access to {name}: the plan/compile caches "
+                "are shared hot state owned by expr/base.py — go "
+                "through lookup_plan / store_plan / cached_executable "
+                "/ clear_plan_cache / clear_compile_cache so the "
+                "locking discipline, LRU order and eviction "
+                "accounting hold"))
+        elif name in _REGISTRY_INTERNALS and not metrics_owner:
+            findings.append(Finding(
+                path, getattr(node, "lineno", 0), "shared-state",
+                f"direct access to registry internals ({name}): use "
+                "REGISTRY.counter()/gauge()/histogram()/snapshot() — "
+                "the instrument tables are lock-guarded shared state "
+                "owned by obs/metrics.py"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            check(node, node.attr)
+        elif isinstance(node, ast.Name):
+            check(node, node.id)
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -376,6 +436,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_raw_timing(path, tree))
         findings.extend(lint_debug_callbacks(path, tree))
         findings.extend(lint_bare_recovery(path, tree))
+        findings.extend(lint_shared_state(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
