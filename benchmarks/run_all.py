@@ -225,6 +225,19 @@ def resilience_overhead(st):
     return ro.measure(iters=60, n=512 if SMALL else 4096)
 
 
+def elastic_overhead(st):
+    """Elastic-recovery gates (benchmarks/elastic_recovery.py): the
+    epoch machinery's off-path cost on the steady-state hit path
+    (<=1% is the ISSUE-7 gate: one epoch compare in the memoized mesh
+    key + one per-leaf epoch compare per dispatch) and time-to-resume
+    (detect -> drain -> rebuild -> evict -> replan -> first
+    post-recovery dispatch; reported, not gated)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import elastic_recovery as er
+
+    return er.measure(iters=60, n=512 if SMALL else 4096)
+
+
 def serving_overhead(st):
     """Serving-engine gates (benchmarks/serving_latency.py): 16-client
     coalesced throughput vs a serial evaluate() loop (>=3x is the
@@ -305,6 +318,9 @@ def guard_metrics(report) -> dict:
             report["serving_overhead"].get("serve_coalesced_speedup"),
         "serve_off_overhead_ratio":
             report["serving_overhead"].get("serve_off_overhead_ratio"),
+        "elastic_off_overhead_ratio":
+            report["elastic_overhead"].get(
+                "elastic_off_overhead_ratio"),
     }
 
 
@@ -330,6 +346,7 @@ def main():
         "numerics_overhead": _with_metrics(numerics_overhead, st),
         "resilience_overhead": _with_metrics(resilience_overhead, st),
         "serving_overhead": _with_metrics(serving_overhead, st),
+        "elastic_overhead": _with_metrics(elastic_overhead, st),
     }
     # full flag state once at report level (the per-record
     # flags_nondefault deltas are diffs against these defaults)
@@ -358,7 +375,8 @@ def main():
                  "obs_overhead_ratio": 0.05,
                  "numerics_off_overhead_ratio": 0.01,
                  "resilience_off_overhead_ratio": 0.01,
-                 "serve_off_overhead_ratio": 0.01}
+                 "serve_off_overhead_ratio": 0.01,
+                 "elastic_off_overhead_ratio": 0.01}
         # fixed FLOORS (ISSUE gates on ratios that must stay high):
         # coalescing must amortize dispatch >=3x across 16 clients
         fixed_min = {"serve_coalesced_speedup": 3.0}

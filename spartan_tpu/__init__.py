@@ -26,8 +26,9 @@ from .array.sparse import SparseDistArray
 from .array.masked import MaskedDistArray
 from .parallel import collectives
 from .parallel import mesh as _mesh
-from .parallel.mesh import (build_mesh, get_mesh, initialize_distributed,
-                            set_mesh, status, use_mesh)
+from .parallel.mesh import (StaleMeshError, build_mesh, get_mesh,
+                            initialize_distributed, mesh_epoch,
+                            rebuild_mesh, set_mesh, status, use_mesh)
 from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import check, lint
 from . import obs
@@ -35,10 +36,10 @@ from .obs import (AuditReport, ExplainReport, Watchpoint, audit, explain,
                   loop_health, metrics, trace_clear, trace_events,
                   trace_export, unwatch, watch)
 from . import resilience
-from .resilience import ChaosPlan, chaos, chaos_clear
+from .resilience import ChaosPlan, FatalMeshError, chaos, chaos_clear
 from . import serve
 from .serve import (Backpressure, DeadlineExceeded, EvalFuture,
-                    ServeEngine, evaluate_async)
+                    MeshReconfiguring, ServeEngine, evaluate_async)
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -48,6 +49,7 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "Tiling", "FLAGS",
             "build_mesh", "get_mesh", "set_mesh", "use_mesh", "initialize",
             "initialize_distributed", "shutdown", "status", "collectives",
+            "rebuild_mesh", "mesh_epoch", "StaleMeshError",
             "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
             "check", "lint",
             "obs", "explain", "ExplainReport", "metrics", "trace_export",
@@ -55,8 +57,9 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
             "loop_health",
             "resilience", "chaos", "chaos_clear", "ChaosPlan",
+            "FatalMeshError",
             "serve", "ServeEngine", "EvalFuture", "evaluate_async",
-            "Backpressure", "DeadlineExceeded"]
+            "Backpressure", "DeadlineExceeded", "MeshReconfiguring"]
            + list(_expr_all))
 
 

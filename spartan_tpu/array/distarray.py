@@ -75,7 +75,8 @@ class DistArray:
     """A distributed N-d array: ``jax.Array`` + :class:`Tiling` over the
     ambient mesh."""
 
-    __slots__ = ("_jax", "tiling", "mesh", "_donate_next", "_donate_site")
+    __slots__ = ("_jax", "tiling", "mesh", "_donate_next", "_donate_site",
+                 "_epoch")
 
     def __init__(self, jax_array: jax.Array, tiling: Tiling,
                  mesh: Optional[Mesh] = None):
@@ -87,6 +88,10 @@ class DistArray:
         self._donate_site = None
         self.tiling = tiling
         self.mesh = mesh or mesh_mod.get_mesh()
+        # birth epoch: using this array after a rebuild_mesh (its
+        # buffers live on the dead mesh) raises StaleMeshError at
+        # dispatch instead of handing XLA a dead-device buffer
+        self._epoch = mesh_mod._EPOCH
 
     # -- buffer donation (expr/base.py evaluate(donate=...)) ------------
 
@@ -229,6 +234,25 @@ class DistArray:
 
     def replicate(self) -> "DistArray":
         return self.retile(tiling_mod.replicated(self.ndim))
+
+    def rehome(self) -> "DistArray":
+        """Migrate this array (IN PLACE) onto the current mesh epoch
+        after a ``rebuild_mesh`` — the one sanctioned mutation outside
+        donation, because healing must reach every holder of the
+        handle (loop closures, caches). Valid only while the buffers
+        are still fetchable (replicated arrays, or simulated loss);
+        an array whose shards died with the device must be re-created
+        from source — elastic recovery says so in its error."""
+        if self._epoch == mesh_mod._EPOCH:
+            return self
+        mesh = mesh_mod.get_mesh()
+        host = np.asarray(jax.device_get(self.jax_array))
+        t = tiling_mod.sanitize(self.tiling, host.shape, mesh)
+        self._jax = jax.device_put(host, t.sharding(mesh))
+        self.tiling = t
+        self.mesh = mesh
+        self._epoch = mesh_mod._EPOCH
+        return self
 
     # -- data health (obs/numerics.py, the numerics sentinel) -----------
 

@@ -904,17 +904,53 @@ def cached_executable(key: Tuple, make: Callable[[], Callable]) -> _Exec:
 
 # mesh object -> its plan-key component. Sorting the axis dict costs
 # ~2.5µs per signature; meshes are few and long-lived, so key them by
-# identity (the stored mesh reference keeps the id stable).
-_mesh_keys: Dict[int, Tuple[Any, Tuple]] = {}
+# identity (the stored mesh reference keeps the id stable). The key
+# LEADS with the mesh epoch and the memo entry records the epoch it
+# was built under, so after a rebuild_mesh (elastic recovery) a cached
+# identity entry for a dead mesh can never resurrect a stale plan key
+# — epoch-N plans miss, and evict_stale_plans() reaps them.
+_mesh_keys: Dict[int, Tuple[Any, Tuple, int]] = {}
 
 
 def _mesh_key(mesh) -> Tuple:
+    epoch = mesh_mod._EPOCH
     hit = _mesh_keys.get(id(mesh))
-    if hit is not None and hit[0] is mesh:
+    if hit is not None and hit[0] is mesh and hit[2] == epoch:
         return hit[1]
-    key = tuple(sorted(mesh.shape.items()))
-    _mesh_keys[id(mesh)] = (mesh, key)
+    key = (epoch,) + tuple(sorted(mesh.shape.items()))
+    _mesh_keys[id(mesh)] = (mesh, key, epoch)
     return key
+
+
+def evict_stale_plans() -> int:
+    """Drop every plan (and its compiled variants — donation sets,
+    serve batches, the degrade rungs) keyed under a mesh epoch older
+    than the current one. Called by elastic recovery after
+    ``rebuild_mesh``; reuses the LRU eviction's prefix rule, so the
+    dead epoch's executables leave the compile cache with their plans
+    and nothing can pin a dead mesh's HBM. Returns plans evicted."""
+    epoch = mesh_mod._EPOCH
+    evicted = 0
+    with _cache_lock:
+        for pk in [k for k in _plan_cache
+                   if isinstance(k, tuple) and len(k) >= 3
+                   and k[2] and k[2][0] != epoch]:
+            old = _plan_cache.pop(pk)
+            pref, plen = old.key, len(old.key)
+            for ck in [k for k in _compile_cache if k[:plen] == pref]:
+                del _compile_cache[ck]
+            evicted += 1
+        # orphan executables (explain pre-plans, uncacheable plans):
+        # the compile key's third element is the epoch-led mesh item
+        # tuple _build_plan wrote
+        for ck in [k for k in _compile_cache
+                   if isinstance(k, tuple) and len(k) >= 3
+                   and isinstance(k[2], tuple) and k[2]
+                   and k[2][0] != epoch]:
+            del _compile_cache[ck]
+    if evicted:
+        prof.count("plan_evictions", evicted)
+    return evicted
 
 
 def plan_signature(expr: "Expr", mesh=None) -> Tuple[Tuple, "_PlanSigCtx"]:
@@ -1052,10 +1088,20 @@ def _gather_args(leaves: List[Expr], order: Tuple[int, ...],
 
     darrs: List[DistArray] = []
     dpos: List[int] = []
+    stale: List[DistArray] = []
+    epoch = mesh_mod._EPOCH
     seen: Dict[int, int] = {}
     for j, leaf in enumerate(ordered):
         arr = _leaf_array(leaf)
         if arr is None:
+            continue
+        if arr._epoch != epoch:
+            # born on a mesh that a rebuild_mesh has since replaced:
+            # its buffers (may) live on dead devices. Raise the clear
+            # error BEFORE XLA sees the buffer; collect every stale
+            # leaf so one rehome pass heals the whole dispatch.
+            if not any(arr is s for s in stale):
+                stale.append(arr)
             continue
         if arr._donate_next or any(arr is d for d in donated):
             if id(arr) in seen:
@@ -1071,6 +1117,16 @@ def _gather_args(leaves: List[Expr], order: Tuple[int, ...],
             dpos.append(j)
             if not any(arr is d for d in darrs):
                 darrs.append(arr)
+    if stale:
+        raise mesh_mod.StaleMeshError(
+            f"{len(stale)} input DistArray(s) belong to mesh epoch "
+            f"{stale[0]._epoch} but the mesh was rebuilt (current "
+            f"epoch {epoch}, e.g. after device loss): their buffers "
+            "live on the previous mesh. Re-create them from source, "
+            "or — if the data is still fetchable (replicated, or a "
+            "simulated loss) — call .rehome() / "
+            "resilience.elastic.rehome() to migrate them.",
+            arrays=stale)
     return args, darrs, dpos
 
 
@@ -1314,8 +1370,13 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
     # The degradation rung is keyed the same way: a fusion-off or
     # finer-tiling replan must never alias the normal executable.
     audit = bool(FLAGS.audit_numerics)
+    # the mesh component leads with the epoch (elastic recovery): a
+    # plan compiled for a dead mesh must never alias a post-rebuild
+    # executable of the same structure, and evict_stale_plans reaps
+    # old-epoch entries by this element
     key = (root_sig, tuple(t.axes for t in out_tilings),
-           tuple(sorted(mesh.shape.items())), audit, degrade_rung)
+           (mesh_mod._EPOCH,) + tuple(sorted(mesh.shape.items())),
+           audit, degrade_rung)
 
     leaf_ids = tuple(l._id for l in leaves)
     out_shardings = tuple(t.sharding(mesh) for t in out_tilings)

@@ -171,10 +171,16 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
     """The structured per-plan report, built on the miss path and
     stored on the ``_Plan`` (shared by the cached and the identity
     variant, so a cache-hit ``st.explain`` is instant)."""
+    from ..parallel import mesh as mesh_mod
+
     report: Dict[str, Any] = {
         "root": _label(expr),
         "site": _site_str(expr._site),
         "plan_key": key_hash(plan_key),
+        # the mesh generation this plan was built for: after an
+        # elastic rebuild (device loss), post-recovery explains show
+        # which epoch — and therefore which device set — a plan binds
+        "mesh_epoch": mesh_mod.mesh_epoch(),
         "passes": passes,
         "optimized_nodes": (passes[-1]["nodes_after"] if passes
                             else None),
@@ -240,6 +246,9 @@ class ExplainReport:
                  f"key {d.get('plan_key')}]"]
         if d.get("site"):
             lines.append(f"  built at {d['site']}")
+        if d.get("mesh_epoch"):  # epoch 0 (no rebuild yet) is implied
+            lines.append(f"  mesh epoch {d['mesh_epoch']} "
+                         "(rebuilt after device loss)")
         if d.get("passes"):
             lines.append("  passes:")
             for p in d["passes"]:

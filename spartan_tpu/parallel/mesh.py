@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -33,6 +34,40 @@ AXIS_ROW = "x"
 AXIS_COL = "y"
 
 _state = threading.local()
+
+# -- mesh epoch (elastic recovery) ---------------------------------------
+#
+# A monotonic process-wide generation counter, bumped by every
+# ``rebuild_mesh`` (device/host loss shrinks the mesh). Everything that
+# binds to a mesh — DistArrays at construction, plan/compile-cache keys
+# at signing time (expr/base._mesh_key) — records the epoch it was born
+# under, so an artifact from a dead mesh can never dispatch: stale
+# plans simply miss the cache, and stale DistArrays raise
+# :class:`StaleMeshError` at arg-gather time instead of handing XLA a
+# buffer on a device that no longer exists. Reads are unlocked (one
+# module-attribute load on the hot path); writes hold ``_epoch_lock``.
+
+_EPOCH = 0
+_epoch_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+_excluded_ids: Tuple[int, ...] = ()
+
+
+def mesh_epoch() -> int:
+    """The current mesh generation (bumped by ``rebuild_mesh``)."""
+    return _EPOCH
+
+
+class StaleMeshError(RuntimeError):
+    """A mesh-bound artifact (DistArray, plan) from a previous mesh
+    epoch was used after ``rebuild_mesh``: its device buffers live on
+    a mesh that no longer exists. Carries the offending arrays on
+    ``.arrays`` so elastic recovery (``resilience/elastic.rehome``)
+    can migrate the ones that are still fetchable."""
+
+    def __init__(self, msg: str, arrays: Sequence = ()):
+        super().__init__(msg)
+        self.arrays = list(arrays)
 
 
 def _factor_2d(n: int) -> Tuple[int, int]:
@@ -64,30 +99,104 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
 
 def set_mesh(mesh: Optional[Mesh]) -> None:
     _state.mesh = mesh
+    _state.epoch = _EPOCH
 
 
 def get_mesh() -> Mesh:
+    """The ambient mesh, epoch-fenced: a thread-local pin (``set_mesh``
+    / ``use_mesh``) from a previous epoch is discarded — after a
+    ``rebuild_mesh`` every thread sees the rebuilt mesh, including
+    threads parked inside a ``use_mesh`` of the dead one."""
     mesh = getattr(_state, "mesh", None)
+    if mesh is not None and getattr(_state, "epoch", 0) == _EPOCH:
+        return mesh
+    global _global_mesh
+    mesh = _global_mesh
     if mesh is None:
-        mesh = build_mesh()
-        _state.mesh = mesh
+        with _epoch_lock:
+            if _global_mesh is None:
+                _global_mesh = _build_surviving()
+            mesh = _global_mesh
+    _state.mesh = mesh
+    _state.epoch = _EPOCH
     return mesh
 
 
 class use_mesh:
-    """Context manager pinning the ambient mesh (tests use a CPU mesh)."""
+    """Context manager pinning the ambient mesh (tests use a CPU mesh).
+
+    The pin is epoch-scoped: if ``rebuild_mesh`` runs inside the
+    context, ``get_mesh`` stops honoring the (now-dead) pinned mesh."""
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
         self._prev: Optional[Mesh] = None
+        self._prev_epoch: int = 0
 
     def __enter__(self) -> Mesh:
         self._prev = getattr(_state, "mesh", None)
+        self._prev_epoch = getattr(_state, "epoch", _EPOCH)
         _state.mesh = self.mesh
+        _state.epoch = _EPOCH
         return self.mesh
 
     def __exit__(self, *exc) -> None:
         _state.mesh = self._prev
+        _state.epoch = self._prev_epoch
+
+
+def _build_surviving(shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Build a mesh over every device NOT excluded by a prior
+    ``rebuild_mesh`` (the current survivor set)."""
+    devices = [d for d in jax.devices() if d.id not in _excluded_ids]
+    if not devices:
+        raise RuntimeError("rebuild_mesh excluded every device")
+    return build_mesh(devices, shape=shape)
+
+
+def rebuild_mesh(exclude_devices: Sequence = (),
+                 shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Shrink (or reshape) the mesh after persistent device/host loss
+    and bump the mesh epoch — the terminal rung of the resilience
+    ladder (docs/RESILIENCE.md, elastic recovery).
+
+    ``exclude_devices`` are devices (or device ids) to REMOVE from the
+    survivor set, cumulative with previous rebuilds. The epoch bump
+    invalidates every mesh-bound artifact: plan/compile-cache keys
+    carry the epoch (stale plans miss), DistArrays record their birth
+    epoch (cross-epoch use raises :class:`StaleMeshError`), and
+    ``get_mesh``'s thread-local pins are fenced. The caller
+    (``resilience/elastic``) is responsible for draining dispatches
+    first and evicting the dead epoch's cache entries after."""
+    global _EPOCH, _global_mesh, _excluded_ids
+    with _epoch_lock:
+        excluded = set(_excluded_ids)
+        for d in exclude_devices:
+            excluded.add(d if isinstance(d, int) else d.id)
+        _excluded_ids = tuple(sorted(excluded))
+        _EPOCH += 1
+        _global_mesh = _build_surviving(shape)
+        _state.mesh = _global_mesh
+        _state.epoch = _EPOCH
+        from ..utils.log import log_warn
+
+        log_warn("mesh epoch %d: rebuilt over %d surviving device(s)"
+                 "%s", _EPOCH, _global_mesh.devices.size,
+                 f" (excluded ids {_excluded_ids})" if _excluded_ids
+                 else "")
+        return _global_mesh
+
+
+def reset_epoch_for_tests() -> None:
+    """Restore the full-device, epoch-0 world (test isolation only:
+    production epochs are monotonic by design)."""
+    global _EPOCH, _global_mesh, _excluded_ids
+    with _epoch_lock:
+        _EPOCH = 0
+        _global_mesh = None
+        _excluded_ids = ()
+        _state.mesh = None
+        _state.epoch = 0
 
 
 def mesh_axis_sizes(mesh: Optional[Mesh] = None) -> Tuple[int, int]:
@@ -108,33 +217,78 @@ def device_count(mesh: Optional[Mesh] = None) -> int:
     return int(np.prod(list(mesh.shape.values())))
 
 
+_dist_initialized = False
+_dist_lock = threading.Lock()
+
+# "already initialized" phrasings across jax versions: the re-entrant
+# fast path treats them as success, not failure
+_ALREADY_INIT = ("already initialized", "already been initialized",
+                 "initialize should be called once")
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> bool:
+                           process_id: Optional[int] = None,
+                           max_attempts: int = 3,
+                           backoff_s: float = 0.5) -> bool:
     """Multi-host bring-up: ``jax.distributed`` plays the role the
     reference's master played (registration/barrier over DCN —
     SURVEY.md §2.7). No-op (returns False) when single-host: args absent
-    and no cluster environment detected."""
+    and no cluster environment detected.
+
+    Re-entrant: a second call (e.g. from elastic recovery after a host
+    loss, or ``st.initialize`` called twice) returns True without
+    re-dialing the coordinator. Transient connect failures
+    (UNAVAILABLE / DEADLINE_EXCEEDED / refused connections — a
+    coordinator restarting after the same host loss that triggered the
+    reconnect) retry up to ``max_attempts`` times with doubling
+    ``backoff_s``; anything else fails once, loudly."""
     import jax
 
-    try:
-        if coordinator_address is not None:
-            jax.distributed.initialize(coordinator_address,
-                                       num_processes, process_id)
-            return True
+    from ..utils.log import log_warn
+
+    global _dist_initialized
+    want = (coordinator_address is not None
+            or bool(os.environ.get("COORDINATOR_ADDRESS")))
+    if not want:
         # Auto-detection ONLY on an explicit coordinator address: a
         # bare SLURM_JOB_ID must not trigger it — a single-process run
         # inside a multi-task allocation would start the coordinator
         # and BLOCK waiting for peers that never register. SLURM/pod
         # users launched on every task call this with explicit args or
         # set COORDINATOR_ADDRESS.
-        if os.environ.get("COORDINATOR_ADDRESS"):
-            jax.distributed.initialize()
+        return False
+    with _dist_lock:
+        if _dist_initialized:
             return True
-    except Exception as e:  # pragma: no cover - env-dependent
-        from ..utils.log import log_warn
-
-        log_warn("jax.distributed initialization failed: %s", e)
+        delay = backoff_s
+        for attempt in range(max(1, max_attempts)):
+            try:
+                if coordinator_address is not None:
+                    jax.distributed.initialize(coordinator_address,
+                                               num_processes, process_id)
+                else:
+                    jax.distributed.initialize()
+                _dist_initialized = True
+                return True
+            except Exception as e:  # pragma: no cover - env-dependent
+                text = str(e).lower()
+                if any(m in text for m in _ALREADY_INIT):
+                    _dist_initialized = True
+                    return True
+                transient = any(m in text for m in (
+                    "unavailable", "deadline", "connection refused",
+                    "connection reset", "failed to connect", "timed out"))
+                if transient and attempt + 1 < max(1, max_attempts):
+                    log_warn("jax.distributed connect attempt %d/%d "
+                             "failed (%s); retrying in %.2fs",
+                             attempt + 1, max_attempts, str(e)[:120],
+                             delay)
+                    time.sleep(delay)
+                    delay *= 2
+                    continue
+                log_warn("jax.distributed initialization failed: %s", e)
+                return False
     return False
 
 

@@ -28,22 +28,35 @@ package makes every failure a tested, observable code path:
   checkpoint_path=p, resume=p)``: atomic periodic carry snapshots,
   restore-on-failure, cross-process resume reproducing the
   uninterrupted run bit-for-bit.
+* :mod:`elastic` — the terminal rung: on persistent device/host loss
+  (``fatal_mesh``: ``DATA_LOSS`` / halted-client statuses, or the
+  injected ``device_loss`` chaos fault) drain the serve engine,
+  ``rebuild_mesh`` over the survivors (bumping the mesh epoch that
+  fences every plan key and DistArray), evict the dead epoch's
+  plans, and let checkpointed loops resume from their snapshots on
+  the shrunken mesh.
 
 See docs/RESILIENCE.md for the failure model and a chaos-testing
 how-to. Import discipline: this package sits below the expr layer
-(config/obs only at import time); expr types are reached lazily.
+(config/obs/parallel.mesh only at import time); expr and serve types
+are reached lazily.
 """
 
-from . import classify, degrade, engine, faults, loop_ckpt
-from .classify import DETERMINISTIC, IO, OOM, TRANSIENT, classify as classify_error
+from . import classify, degrade, elastic, engine, faults, loop_ckpt
+from .classify import (DETERMINISTIC, FATAL_MESH, IO, OOM, STALE_MESH,
+                       TRANSIENT, FatalMeshError,
+                       classify as classify_error)
 from .faults import (ChaosPlan, InjectedCheckpointError,
-                     InjectedCompileError, InjectedOOMError,
-                     InjectedTransientError, chaos, chaos_clear)
+                     InjectedCompileError, InjectedDeviceLossError,
+                     InjectedOOMError, InjectedTransientError, chaos,
+                     chaos_clear)
 
 __all__ = [
     "chaos", "chaos_clear", "ChaosPlan", "classify_error",
-    "TRANSIENT", "OOM", "IO", "DETERMINISTIC",
+    "TRANSIENT", "OOM", "IO", "DETERMINISTIC", "FATAL_MESH",
+    "STALE_MESH", "FatalMeshError",
     "InjectedTransientError", "InjectedOOMError",
     "InjectedCompileError", "InjectedCheckpointError",
-    "classify", "degrade", "engine", "faults", "loop_ckpt",
+    "InjectedDeviceLossError",
+    "classify", "degrade", "elastic", "engine", "faults", "loop_ckpt",
 ]

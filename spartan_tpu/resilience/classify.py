@@ -18,6 +18,14 @@ class           what it covers                          policy
                                                         off -> chunked)
 ``io``          OSError from the checkpoint IO layer    retry (driver
                                                         level)
+``fatal_mesh``  persistent device/host death:           elastic recovery
+                DATA_LOSS, halted-client errors,        (drain, rebuild
+                ``INTERNAL: ... device``                mesh, evict dead
+                (:class:`FatalMeshError`)               epoch, resume
+                                                        from checkpoint)
+``stale_mesh``  a pre-rebuild DistArray/plan used       fail fast (or
+                after the mesh epoch advanced           rehome, for the
+                (``StaleMeshError``)                    loop driver)
 ``deterministic`` everything else: user errors          fail fast with
                 (ValueError/TypeError/ExprError),       the plan report
                 INVALID_ARGUMENT compile errors, ...    attached
@@ -37,6 +45,23 @@ TRANSIENT = "transient"
 OOM = "oom"
 IO = "io"
 DETERMINISTIC = "deterministic"
+FATAL_MESH = "fatal_mesh"
+STALE_MESH = "stale_mesh"
+
+
+class FatalMeshError(RuntimeError):
+    """A device/host is gone for good: the mesh itself is dead, and no
+    retry of the same plan can succeed — the terminal rung of the
+    resilience ladder. The policy engine routes this class into
+    elastic recovery (``resilience/elastic``): drain the serve engine,
+    ``rebuild_mesh`` over the survivors, evict the dead epoch's plans,
+    then loops resume from their checkpoints and serve clients
+    resubmit. ``failed_devices`` (when known) names the casualties for
+    the rebuild's exclusion list."""
+
+    def __init__(self, msg: str, failed_devices=()):
+        super().__init__(msg)
+        self.failed_devices = tuple(failed_devices)
 
 # RESOURCE_EXHAUSTED is the XLA/gRPC status for allocation failure;
 # the free-text forms cover PJRT allocator messages.
@@ -56,25 +81,56 @@ _TRANSIENT_MARKERS = (
     "heartbeat", "network", "too many pings",
 )
 
+# Persistent device/host death — the statuses the TPU runtime emits
+# when a chip or its host is gone for good (vs the transient flavors
+# above, where a re-dispatch can succeed once the condition clears):
+# DATA_LOSS (shard contents unrecoverable), halted-client errors (the
+# runtime halts every client attached to the failed slice), explicit
+# device-failure wordings. Checked BEFORE the transient table: "device
+# lost" stays retryable, "device halted"/"DATA_LOSS" does not.
+_FATAL_MESH_MARKERS = (
+    "data_loss", "data loss", "device halted", "chip halted",
+    "halted client", "client has been halted", "device failure",
+    "device unhealthy", "hardware failure", "missing device",
+)
+
+# XLA INTERNAL is normally deterministic (compiler bugs), but an
+# INTERNAL naming a device fault is the runtime reporting hardware
+# death through the generic status
+_INTERNAL_DEVICE_MARKERS = ("device", "chip", "tpu core")
+
 
 def _match(text: str, markers: tuple) -> bool:
     return any(m in text for m in markers)
 
 
 def classify(exc: BaseException) -> str:
-    """Map an exception to one of the four recovery classes."""
+    """Map an exception to one of the six recovery classes."""
     kind = getattr(exc, "fault_kind", None)
     if kind is not None:  # injected faults label themselves, but their
         # messages ALSO match the patterns below; the attribute is just
         # the fast path (and covers hypothetical pattern drift)
         return {"transient": TRANSIENT, "oom": OOM, "io": IO,
+                "device_loss": FATAL_MESH,
                 "compile": DETERMINISTIC}.get(kind, DETERMINISTIC)
+    if isinstance(exc, FatalMeshError):
+        return FATAL_MESH
+    # lazy: parallel.mesh is loaded long before any failure classifies
+    from ..parallel.mesh import StaleMeshError
+
+    if isinstance(exc, StaleMeshError):
+        return STALE_MESH
     if isinstance(exc, OSError):
         return IO
     text = str(exc).lower()
     if isinstance(exc, (MemoryError,)):
         return OOM
     if isinstance(exc, RuntimeError):
+        if _match(text, _FATAL_MESH_MARKERS):
+            return FATAL_MESH
+        if text.startswith("internal") and _match(
+                text, _INTERNAL_DEVICE_MARKERS):
+            return FATAL_MESH
         if _match(text, _OOM_MARKERS):
             return OOM
         if _match(text, _TRANSIENT_MARKERS):

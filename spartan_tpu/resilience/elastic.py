@@ -1,0 +1,187 @@
+"""Elastic mesh recovery: the terminal rung of the resilience ladder.
+
+Retry (PR 5) assumes the failing dispatch can succeed on the SAME
+mesh; the OOM ladder assumes the mesh fits a smaller plan. Persistent
+device/host death breaks both assumptions — the reference Spartan's
+answer was lineage-based worker-death recovery (PAPER.md §5: the
+master re-tiles over the survivors and the computation continues), and
+this module is that answer rebuilt at GSPMD scale:
+
+1. **detect** — ``resilience.classify`` maps persistent device-death
+   statuses (``DATA_LOSS``, halted-client errors, ``INTERNAL: ...
+   device``) and the injected ``device_loss`` chaos fault to
+   ``fatal_mesh``; the policy engine routes that class here instead of
+   retrying.
+2. **drain** — the serve engine stops admitting (submissions and the
+   queued backlog fail with a retryable
+   :class:`~spartan_tpu.serve.future.MeshReconfiguring` carrying a
+   retry-after), so no new dispatch can land on the dead mesh.
+3. **rebuild** — ``parallel.mesh.rebuild_mesh(exclude_devices=...)``
+   shrinks the mesh to the survivors and bumps the **mesh epoch**.
+4. **invalidate** — every mesh-bound artifact is fenced by the epoch:
+   plan/compile-cache keys carry it (stale plans miss;
+   ``expr.base.evict_stale_plans`` reaps them here), DistArrays record
+   their birth epoch (cross-epoch use raises ``StaleMeshError``), and
+   ``get_mesh``'s thread-local pins are epoch-fenced.
+5. **resume** — ``st.loop`` restores its carries from the latest
+   ``LATEST.json`` snapshot (host-side restore sidesteps live
+   redistribution: the planner's re-tile on the shrunken mesh is just
+   a fresh ``_build_plan``) and re-enters the loop on the new mesh;
+   serve clients resubmit after the retry-after.
+
+What is recoverable: checkpointed loops (carries restored from disk),
+serve traffic (resubmission), and any DistArray whose data is still
+fetchable (replicated, or a simulated loss) via :func:`rehome`. What
+is NOT: un-checkpointed state whose shards died with the device — the
+``StaleMeshError`` says to re-create it from source.
+
+Recovery is idempotent per epoch: concurrent fatal failures from
+several serve workers trigger ONE drain/rebuild/evict (the losers
+observe the bumped epoch and return). ``FLAGS.elastic_recovery=False``
+turns the rung off — fatal mesh errors then fail fast like
+deterministic ones.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, List, Optional, Sequence
+
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..parallel import mesh as mesh_mod
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
+from ..utils.log import log_warn
+
+FLAGS.define_bool(
+    "elastic_recovery", True,
+    "Master switch for elastic mesh recovery: on a fatal_mesh "
+    "failure, drain the serve engine, rebuild the mesh over the "
+    "surviving devices (bumping the mesh epoch), evict the dead "
+    "epoch's plans, and let checkpointed loops resume. Off = fatal "
+    "mesh errors fail fast like deterministic ones.")
+FLAGS.define_float(
+    "elastic_retry_after_s", 0.1,
+    "retry-after carried by MeshReconfiguring rejections during a "
+    "mesh rebuild: the drain-and-rebuild is host-side work, so "
+    "clients can resubmit almost immediately.")
+
+_lock = threading.Lock()
+
+# "device 3", "device: 3", "TPU_4" etc. in real status messages
+_DEV_RE = re.compile(r"device[:\s#]*(\d+)", re.IGNORECASE)
+
+
+def _count(name: str, help_: str, n: int = 1) -> None:
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(name, help_).inc(n)
+
+
+def infer_failed_devices(exc: BaseException) -> List[int]:
+    """Which devices died, from the failure itself: an explicit
+    ``failed_devices`` attribute (injected faults, FatalMeshError),
+    else ``device N`` parsed from the status message, else the
+    highest-ordinal device still in the mesh (a loss the runtime did
+    not attribute must still shrink the mesh to make progress)."""
+    ids = [int(d) for d in getattr(exc, "failed_devices", ()) or ()]
+    if not ids:
+        seen = getattr(exc, "__cause__", None)
+        if seen is not None:
+            ids = [int(d) for d in getattr(seen, "failed_devices", ())
+                   or ()]
+    if not ids:
+        m = _DEV_RE.search(str(exc))
+        if m:
+            ids = [int(m.group(1))]
+    if not ids:
+        mesh = mesh_mod.get_mesh()
+        ids = [max(d.id for d in mesh.devices.flat)]
+    return ids
+
+
+def _drain_serve(retry_after_s: float) -> int:
+    """Stop the default serve engine admitting and fail its queued
+    backlog with MeshReconfiguring (in-flight dispatches fail on
+    their own and are mapped by the worker). No-op without a running
+    engine. Returns requests drained."""
+    from ..serve import engine as serve_engine
+
+    eng = serve_engine.peek_default()
+    if eng is None or not eng.running:
+        return 0
+    return eng.drain_reconfiguring(retry_after_s)
+
+
+def on_fatal_mesh(exc: BaseException, mesh: Any = None) -> Optional[Any]:
+    """Executed by the policy engine when a dispatch failure classifies
+    ``fatal_mesh``: drain → rebuild → evict, idempotent per epoch.
+
+    Returns the rebuilt mesh (or the current one, when another thread
+    already recovered this epoch); None when elastic recovery is
+    disabled. The caller still raises — the failed evaluation itself
+    is not replayable (its inputs live on the dead mesh); recovery
+    makes the NEXT dispatch (a loop's restored segment, a client's
+    resubmission) land on a live mesh."""
+    if not FLAGS.elastic_recovery:
+        return None
+    seen_epoch = mesh_mod._EPOCH
+    with _lock:
+        if mesh_mod._EPOCH != seen_epoch:
+            # another worker's recovery already rebuilt past the epoch
+            # this failure was dispatched under
+            return mesh_mod.get_mesh()
+        lost = infer_failed_devices(exc)
+        retry_after = FLAGS.elastic_retry_after_s
+        with prof.span("elastic_recover", epoch=seen_epoch,
+                       lost=tuple(lost)) as sp:
+            with prof.phase("drain"):
+                drained = _drain_serve(retry_after)
+            with prof.phase("rebuild"):
+                new_mesh = mesh_mod.rebuild_mesh(exclude_devices=lost)
+            from ..expr import base as expr_base
+
+            with prof.phase("evict"):
+                evicted = expr_base.evict_stale_plans()
+            sp.set(drained=drained, evicted=evicted,
+                   survivors=int(new_mesh.devices.size))
+        _count("elastic_recoveries",
+               "fatal mesh failures recovered by drain/rebuild/evict")
+        _count("elastic_plans_evicted",
+               "dead-epoch plans evicted during elastic recovery",
+               evicted)
+        _resume_serve()
+        log_warn(
+            "elastic: mesh epoch %d -> %d after device loss %s — %d "
+            "survivor(s), %d plan(s) evicted, %d serve request(s) "
+            "drained; resume loops from checkpoint, resubmit serve "
+            "requests", seen_epoch, mesh_mod._EPOCH, lost,
+            int(new_mesh.devices.size), evicted, drained)
+        return new_mesh
+
+
+def _resume_serve() -> None:
+    from ..serve import engine as serve_engine
+
+    eng = serve_engine.peek_default()
+    if eng is not None:
+        eng.resume_admission()
+
+
+def rehome(arrays: Sequence[Any]) -> int:
+    """Migrate stale-epoch DistArrays onto the current mesh (host
+    round-trip, in place — see ``DistArray.rehome``). The loop driver
+    calls this with ``StaleMeshError.arrays`` after a recovery, so a
+    body closure's captured leaves (the k-means points) follow the
+    carries onto the shrunken mesh. Returns arrays migrated."""
+    n = 0
+    for arr in arrays:
+        if getattr(arr, "_epoch", None) != mesh_mod._EPOCH:
+            arr.rehome()
+            n += 1
+    if n:
+        _count("elastic_rehomed",
+               "stale-epoch DistArrays migrated onto the rebuilt "
+               "mesh", n)
+    return n

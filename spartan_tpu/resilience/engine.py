@@ -205,6 +205,52 @@ def handle_failure(exc: BaseException, expr: Any, plan: Any,
         {"class": kind, "error": f"{type(exc).__name__}: "
                                  f"{str(exc)[:200]}"})
 
+    if kind == cls.FATAL_MESH:
+        # persistent device/host death: no retry of the same plan can
+        # succeed. Run elastic recovery (drain serve -> rebuild mesh
+        # over survivors -> evict the dead epoch's plans), then raise
+        # a FatalMeshError — the failed evaluation's inputs live on
+        # the dead mesh, so the RESUME happens above us: checkpointed
+        # loops restore from snapshot, serve clients resubmit.
+        from . import elastic
+
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "resilience_fatal_mesh_faults",
+                "dispatch failures classified fatal_mesh "
+                "(persistent device/host loss)").inc()
+        new_mesh = elastic.on_fatal_mesh(exc, mesh)
+        if new_mesh is None:  # FLAGS.elastic_recovery off: fail fast
+            _attach_note(
+                exc, "resilience: fatal mesh failure and elastic "
+                "recovery is disabled (FLAGS.elastic_recovery)")
+            _dump("fatal mesh failure (elastic off)", plan, rec)
+            raise exc
+        rec["mesh_rebuilt"] = True
+        if isinstance(exc, cls.FatalMeshError):
+            _attach_note(
+                exc, f"resilience: mesh rebuilt over "
+                f"{int(new_mesh.devices.size)} surviving device(s) "
+                "(elastic recovery); resume loops from their "
+                "checkpoints, resubmit serve requests")
+            raise exc
+        raise cls.FatalMeshError(
+            f"persistent device/host loss ({type(exc).__name__}: "
+            f"{str(exc)[:200]}); mesh rebuilt over "
+            f"{int(new_mesh.devices.size)} surviving device(s) — "
+            "resume loops from their checkpoints, resubmit serve "
+            "requests",
+            failed_devices=getattr(exc, "failed_devices", ()),
+        ) from exc
+
+    if kind == cls.STALE_MESH:
+        # a pre-rebuild input reached dispatch: fail fast with the
+        # remedy (the loop driver intercepts this and rehomes)
+        _attach_note(
+            exc, "resilience: stale mesh epoch — not retried (rehome "
+            "or re-create the inputs on the rebuilt mesh)")
+        raise exc
+
     if kind == cls.OOM:
         if degrade.active_rung() is not None:
             # already inside a degraded re-plan: let the OUTER ladder

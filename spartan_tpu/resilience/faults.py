@@ -29,6 +29,14 @@ comma-separated list of tokens::
     slow@3=0.5         dispatch occurrence #3 stalls 0.5 s (watchdog food)
     compile@0          the first compile raises a deterministic error
     io@1               the second checkpoint write raises OSError
+    device_loss@2      dispatch occurrence #2 raises a PERSISTENT
+                       device-death error (DATA_LOSS/halted-client
+                       status) classified FatalMeshError -> elastic
+                       recovery: drain, rebuild_mesh over survivors,
+                       resume loops from checkpoint. The injected
+                       error names the simulated casualty (the
+                       highest-ordinal device) so the recovery path
+                       exercises exclusion without a real dead chip.
 
 Injected exceptions carry ``injected=True`` and messages matching the
 real-world patterns (``UNAVAILABLE``, ``RESOURCE_EXHAUSTED``,
@@ -98,6 +106,37 @@ class InjectedCheckpointError(OSError):
     fault_kind = "io"
 
 
+class InjectedDeviceLossError(RuntimeError):
+    """Injected analogue of persistent device/host death (DATA_LOSS /
+    halted-client status): classified ``fatal_mesh`` and routed into
+    elastic recovery. ``failed_devices`` carries the simulated
+    casualty's device id for the rebuild's exclusion list."""
+
+    injected = True
+    fault_kind = "device_loss"
+
+    def __init__(self, msg: str, failed_devices=()):
+        super().__init__(msg)
+        self.failed_devices = tuple(failed_devices)
+
+
+def _make_device_loss(msg: str, site: str, idx: int
+                      ) -> InjectedDeviceLossError:
+    """The simulated casualty is the highest-ordinal device still IN
+    the mesh: real losses name the dead chip in the status; here the
+    injection picks one deterministically so classifier tests and the
+    elastic acceptance scenario run without a real dead chip — and a
+    second injected loss kills a fresh survivor, not the same corpse.
+    Lazy import: the mesh layer is loaded long before any fault
+    fires."""
+    from ..parallel import mesh as mesh_mod
+
+    victim = max(d.id for d in mesh_mod.get_mesh().devices.flat)
+    return InjectedDeviceLossError(
+        msg.format(site=site, idx=idx, dev=victim),
+        failed_devices=(victim,))
+
+
 _EXC = {
     "transient": (InjectedTransientError,
                   "UNAVAILABLE: injected transient fault "
@@ -110,11 +149,15 @@ _EXC = {
                 "(chaos {site}#{idx})"),
     "io": (InjectedCheckpointError,
            "injected checkpoint IO error (chaos {site}#{idx})"),
+    "device_loss": (InjectedDeviceLossError,
+                    "DATA_LOSS: injected device loss: device {dev} "
+                    "halted (client has been halted; chaos "
+                    "{site}#{idx})"),
 }
 
-_KINDS = ("transient", "oom", "slow", "compile", "io")
+_KINDS = ("transient", "oom", "slow", "compile", "io", "device_loss")
 _TOKEN = re.compile(
-    r"^(?P<kind>[a-z]+)"
+    r"^(?P<kind>[a-z_]+)"
     r"(?:@(?P<at>\d+))?"
     r"(?:x(?P<count>\d+))?"
     r"(?::(?P<prob>[0-9.]+))?"
@@ -228,6 +271,8 @@ class ChaosPlan:
                 time.sleep(spec.dur)
                 continue
             exc_type, msg = _EXC[spec.kind]
+            if spec.kind == "device_loss":
+                raise _make_device_loss(msg, site, idx)
             raise exc_type(msg.format(site=site, idx=idx))
 
     # -- installation --------------------------------------------------

@@ -170,6 +170,7 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
     }
     step = start
     restores = 0
+    rehome_passes = 0
     stopped_early = False
     with prof.span("ckpt_loop", n=n, every=every, start=start):
         while step < n and not stopped_early:
@@ -199,8 +200,45 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
                         # early-exit decision below reads the series
                         obs_numerics._flush_effects(tuple(results))
             except Exception as e:
-                if cls.classify(e) == cls.DETERMINISTIC:
+                kind = cls.classify(e)
+                if kind == cls.DETERMINISTIC:
                     raise
+                if kind == cls.STALE_MESH:
+                    # after an elastic mesh rebuild, leaves captured
+                    # by the body closure (the k-means points) still
+                    # sit on the dead epoch: rehome them onto the new
+                    # mesh and re-run the segment — the carries are
+                    # already current (restored from snapshot or
+                    # rehomed themselves). Each pass heals every array
+                    # the error names, so this converges in one or two
+                    # passes; the guard bounds pathological cases.
+                    from . import elastic
+
+                    rehome_passes += 1
+                    if rehome_passes > 8 or not elastic.rehome(
+                            getattr(e, "arrays", ())):
+                        raise
+                    rec["rehomed"] = (rec.get("rehomed", 0)
+                                      + len(e.arrays))
+                    log_warn("st.loop: rehomed %d stale leaf "
+                             "array(s) onto mesh epoch; re-running "
+                             "segment at iteration %d",
+                             len(e.arrays), step)
+                    continue
+                if kind == cls.FATAL_MESH:
+                    # the policy engine already ran elastic recovery
+                    # (drain -> rebuild_mesh -> evict); what is left
+                    # is OUR rung: restore the carries from the last
+                    # committed snapshot and re-enter the loop on the
+                    # shrunken mesh. Falls through to the shared
+                    # restore path below — load_latest lands the
+                    # carries on the CURRENT (rebuilt) mesh, and held
+                    # old-epoch carries are healed by the stale-mesh
+                    # branch above on the re-run.
+                    rec["mesh_rebuilt"] = True
+                    _count("resilience_loop_elastic_resumes",
+                           "checkpointed loops re-entered on a "
+                           "rebuilt mesh after device loss")
                 restores += 1
                 rec["restores"] = restores
                 _count("resilience_loop_restores",

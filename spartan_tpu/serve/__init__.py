@@ -21,6 +21,10 @@ just concurrency:
   construction). ``st.explain`` names the coalesced batch.
 * **tenancy** — per-tenant request counters in the Prometheus export
   and per-tenant retry budgets in the resilience engine.
+* **elastic drain** — during a mesh rebuild after device loss
+  (``resilience/elastic``), admission pauses and queued/in-flight
+  requests fail with the retryable :class:`MeshReconfiguring`
+  carrying a retry-after; clients resubmit onto the rebuilt mesh.
 
 Locking discipline (the concurrency contract of the whole hot path;
 see also expr/base.py's shared-state comment):
@@ -43,12 +47,14 @@ contract and benchmarks/serving_latency.py for the acceptance gates.
 
 from .coalesce import reset_modes
 from .engine import (ServeEngine, default_engine, evaluate_async,
-                     shutdown_default)
-from .future import Backpressure, DeadlineExceeded, EvalFuture, ServeError
+                     peek_default, shutdown_default)
+from .future import (Backpressure, DeadlineExceeded, EvalFuture,
+                     MeshReconfiguring, ServeError)
 from .queue import AdmissionQueue
 
 __all__ = [
     "ServeEngine", "AdmissionQueue", "EvalFuture", "ServeError",
-    "Backpressure", "DeadlineExceeded", "evaluate_async",
-    "default_engine", "shutdown_default", "reset_modes",
+    "Backpressure", "DeadlineExceeded", "MeshReconfiguring",
+    "evaluate_async", "default_engine", "peek_default",
+    "shutdown_default", "reset_modes",
 ]

@@ -49,8 +49,9 @@ from ..resilience import engine as resilience_engine
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from ..utils.log import log_warn
+from ..resilience import classify as resilience_classify
 from . import coalesce
-from .future import DeadlineExceeded, EvalFuture
+from .future import DeadlineExceeded, EvalFuture, MeshReconfiguring
 from .queue import AdmissionQueue
 
 FLAGS.define_int(
@@ -149,6 +150,10 @@ class ServeEngine:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
+        # elastic recovery gate: while the mesh rebuilds, submissions
+        # fail fast with MeshReconfiguring(retry_after_s=this value)
+        # instead of queueing onto a dead mesh. None = admitting.
+        self._reconfiguring: Optional[float] = None
 
     # -- lifecycle ------------------------------------------------------
 
@@ -186,6 +191,31 @@ class ServeEngine:
     def __exit__(self, *exc: Any) -> None:
         self.stop()
 
+    # -- elastic recovery (resilience/elastic.py) -----------------------
+
+    def drain_reconfiguring(self, retry_after_s: float) -> int:
+        """Stop admitting and fail the queued backlog with a retryable
+        :class:`MeshReconfiguring` — called by elastic recovery before
+        the mesh rebuild so nothing else dispatches onto the dead
+        mesh. Workers stay up (their in-flight failures are mapped to
+        MeshReconfiguring by ``_solo``); ``resume_admission`` reopens
+        the door after the rebuild. Returns requests drained."""
+        self._reconfiguring = float(retry_after_s)
+        drained = self.queue.drain()
+        for r in drained:
+            r.future._reject(MeshReconfiguring(
+                retry_after_s, "request drained before dispatch"))
+        if drained and _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "serve_mesh_drained",
+                "queued requests drained by elastic mesh "
+                "recovery").inc(len(drained))
+        return len(drained)
+
+    def resume_admission(self) -> None:
+        """Reopen admission after the mesh rebuild completed."""
+        self._reconfiguring = None
+
     # -- submission -----------------------------------------------------
 
     def submit(self, expr: Any, donate: Sequence[Any] = (),
@@ -194,6 +224,9 @@ class ServeEngine:
         """Admit one evaluation; returns its future immediately.
         Raises :class:`Backpressure` past the queue's high-water mark."""
         expr = base.as_expr(expr)
+        gate = self._reconfiguring
+        if gate is not None:
+            raise MeshReconfiguring(gate, "admission paused")
         if _METRICS_FLAG._value:
             REGISTRY.counter(
                 "serve_requests", "requests submitted to the serve "
@@ -341,7 +374,20 @@ class ServeEngine:
             except Exception as e:
                 # the resilience engine already ran (classified,
                 # retried under the tenant's budget); hand the terminal
-                # failure to the caller through its future
+                # failure to the caller through its future. A fatal
+                # mesh failure is the one remap: elastic recovery has
+                # already rebuilt the mesh by the time the engine
+                # re-raised, so the caller gets the retryable
+                # MeshReconfiguring-with-retry-after contract instead
+                # of the raw device-death status.
+                if (resilience_classify.classify(e)
+                        == resilience_classify.FATAL_MESH):
+                    mr = MeshReconfiguring(
+                        FLAGS.elastic_retry_after_s,
+                        "dispatch hit device loss; mesh rebuilt")
+                    mr.__cause__ = e
+                    r.future._reject(mr)
+                    return
                 r.future._reject(e)
                 return
         r.future.coalesced = 1
@@ -361,6 +407,13 @@ def default_engine() -> ServeEngine:
         if _default is None:
             _default = ServeEngine()
         return _default.start()
+
+
+def peek_default() -> Optional[ServeEngine]:
+    """The default engine if one exists — WITHOUT starting it (elastic
+    recovery drains the engine only if there is one to drain)."""
+    with _default_lock:
+        return _default
 
 
 def shutdown_default() -> None:

@@ -45,6 +45,23 @@ class DeadlineExceeded(ServeError):
     was shed from the queue) or before its result resolved."""
 
 
+class MeshReconfiguring(ServeError):
+    """The mesh is being rebuilt after persistent device/host loss
+    (elastic recovery): this request was drained, or arrived during
+    the drain, and was NOT dispatched. Retryable — resubmit after
+    ``retry_after_s``; the rebuild is host-side work, so the engine is
+    admitting again almost immediately, with plans re-built for the
+    surviving devices. Inputs that lived on the dead mesh must be
+    re-created (or ``.rehome()``d) before resubmitting — a stale
+    resubmission fails with ``StaleMeshError`` naming them."""
+
+    def __init__(self, retry_after_s: float, detail: str = ""):
+        super().__init__(
+            "mesh reconfiguring after device loss; retry after "
+            f"~{retry_after_s:.3f}s" + (f" ({detail})" if detail else ""))
+        self.retry_after_s = retry_after_s
+
+
 class EvalFuture:
     """Resolution handle for one submitted evaluation.
 

@@ -214,3 +214,44 @@ def test_shared_state_accessor_use_is_clean(tmp_path):
         "REGISTRY.gauge('serve_queue_depth').set(3)\n")
     tree = ast.parse(ok.read_text(), filename=str(ok))
     assert lint_repo.lint_shared_state(str(ok), tree) == []
+
+
+def test_catches_mesh_capture(tmp_path):
+    bad = tmp_path / "cachey.py"
+    bad.write_text(
+        "from spartan_tpu.parallel.mesh import get_mesh, build_mesh\n"
+        "from jax.sharding import Mesh\n"
+        "_MESH = get_mesh()\n"                       # module global
+        "GRID = build_mesh(None, shape=(4, 2))\n"    # module global
+        "class Planner:\n"
+        "    mesh = Mesh(None, ('x', 'y'))\n"        # class attribute
+        "def refresh():\n"
+        "    global _MESH\n"
+        "    _MESH = get_mesh()\n")                  # global via decl
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_mesh_capture(str(bad), tree)
+    assert sum(f.rule == "mesh-capture" for f in findings) == 4
+    assert all("rebuild_mesh" in f.message for f in findings)
+
+
+def test_mesh_capture_allows_use_time_and_instances(tmp_path):
+    ok = tmp_path / "clean.py"
+    ok.write_text(
+        "from spartan_tpu.parallel.mesh import get_mesh\n"
+        "def run():\n"
+        "    mesh = get_mesh()\n"                   # use-time local
+        "    return mesh\n"
+        "class Arr:\n"
+        "    def __init__(self):\n"
+        "        self.mesh = get_mesh()\n")         # instance attr
+    tree = ast.parse(ok.read_text(), filename=str(ok))
+    assert lint_repo.lint_mesh_capture(str(ok), tree) == []
+
+
+def test_mesh_capture_allowed_in_parallel():
+    # the owning package holds the one sanctioned global (the
+    # epoch-fenced _global_mesh rebuild_mesh maintains)
+    path = os.path.join(lint_repo.REPO, "spartan_tpu", "parallel",
+                        "mesh.py")
+    tree = ast.parse("from x import get_mesh\n_M = get_mesh()\n")
+    assert lint_repo.lint_mesh_capture(path, tree) == []

@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Six repo-specific rules that generic linters cannot know:
+Seven repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -59,6 +59,19 @@ Six repo-specific rules that generic linters cannot know:
    accessors (``lookup_plan`` / ``store_plan`` / ``cached_executable``
    / ``clear_*``; ``REGISTRY.counter()/gauge()/histogram()``).
 
+7. No mesh object stored in module globals or class attributes
+   outside ``spartan_tpu/parallel/`` (the elastic-recovery PR): a
+   ``get_mesh()``/``build_mesh()``/``Mesh(...)`` result captured in a
+   long-lived global outlives a ``rebuild_mesh`` — after device loss
+   the mesh epoch advances and every cached mesh (and any sharding
+   derived from it) points at dead devices, invisible to the
+   epoch fence that protects ``get_mesh()`` callers. Flagged shapes:
+   module-level and class-body assignments whose value calls one of
+   those constructors, and function-body assignments to names
+   declared ``global``. Instance attributes (a DistArray's birth
+   mesh) are fine — they carry the birth EPOCH alongside, and
+   cross-epoch use raises ``StaleMeshError``.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -114,6 +127,12 @@ _CACHE_NAMES = {"_plan_cache", "_compile_cache", "_cache_lock"}
 _CACHE_OWNER = os.path.join("spartan_tpu", "expr", "base.py")
 _REGISTRY_INTERNALS = {"_counters", "_gauges", "_hists"}
 _METRICS_OWNER = os.path.join("spartan_tpu", "obs", "metrics.py")
+
+# rule 7: mesh constructors whose results must not live in module
+# globals / class attributes outside the owning package — a captured
+# mesh outlives rebuild_mesh and dodges the epoch fence
+_MESH_MAKERS = {"get_mesh", "build_mesh", "rebuild_mesh", "Mesh"}
+_MESH_ALLOWED_DIRS = (os.path.join("spartan_tpu", "parallel") + os.sep,)
 
 
 class Finding:
@@ -352,6 +371,72 @@ def lint_shared_state(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def _calls_mesh_maker(value: ast.AST) -> Optional[str]:
+    """The mesh-constructor name called anywhere under ``value``, or
+    None. Matches ``get_mesh()``, ``mesh_mod.build_mesh(...)``,
+    ``Mesh(arr, axes)`` — by the final name segment."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name in _MESH_MAKERS:
+                return name
+    return None
+
+
+def lint_mesh_capture(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 7: no mesh object captured in module globals or class
+    attributes outside parallel/ — a stored mesh outlives
+    rebuild_mesh and dodges the epoch fence (elastic recovery)."""
+    rel = os.path.relpath(path, REPO)
+    if any(rel.startswith(d) for d in _MESH_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, maker: str, where: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "mesh-capture",
+            f"{maker}() result stored in a {where}: a captured mesh "
+            "outlives rebuild_mesh (device loss bumps the mesh epoch "
+            "and the stored mesh points at dead devices). Call "
+            "get_mesh() at use time, or store the mesh on an instance "
+            "TOGETHER with its birth epoch (as DistArray does)"))
+
+    def scan_block(body, where: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                if value is None:
+                    continue
+                maker = _calls_mesh_maker(value)
+                if maker:
+                    flag(stmt, maker, where)
+
+    scan_block(tree.body, "module global")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            scan_block(node.body, "class attribute")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            declared_global = {
+                n for stmt in ast.walk(node)
+                if isinstance(stmt, ast.Global) for n in stmt.names}
+            if not declared_global:
+                continue
+            for stmt in ast.walk(node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                targets = {t.id for t in stmt.targets
+                           if isinstance(t, ast.Name)}
+                if targets & declared_global:
+                    maker = _calls_mesh_maker(stmt.value)
+                    if maker:
+                        flag(stmt, maker, "module global (via "
+                             "`global` declaration)")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -437,6 +522,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_debug_callbacks(path, tree))
         findings.extend(lint_bare_recovery(path, tree))
         findings.extend(lint_shared_state(path, tree))
+        findings.extend(lint_mesh_capture(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
