@@ -238,6 +238,18 @@ def elastic_overhead(st):
     return er.measure(iters=60, n=512 if SMALL else 4096)
 
 
+def memgov_overhead(st):
+    """Memory-governor gates (benchmarks/memory_governor.py): the
+    hit-path cost with no budget known (<=1% is the ISSUE-8 gate:
+    one _Plan.governed_rung slot read per dispatch; the estimator
+    runs on misses only) plus the model's predicted-vs-XLA
+    memory_analysis error report."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import memory_governor as mg
+
+    return mg.measure(iters=60, n=512 if SMALL else 4096)
+
+
 def serving_overhead(st):
     """Serving-engine gates (benchmarks/serving_latency.py): 16-client
     coalesced throughput vs a serial evaluate() loop (>=3x is the
@@ -321,6 +333,9 @@ def guard_metrics(report) -> dict:
         "elastic_off_overhead_ratio":
             report["elastic_overhead"].get(
                 "elastic_off_overhead_ratio"),
+        "memgov_off_overhead_ratio":
+            report["memgov_overhead"].get(
+                "memgov_off_overhead_ratio"),
     }
 
 
@@ -347,6 +362,7 @@ def main():
         "resilience_overhead": _with_metrics(resilience_overhead, st),
         "serving_overhead": _with_metrics(serving_overhead, st),
         "elastic_overhead": _with_metrics(elastic_overhead, st),
+        "memgov_overhead": _with_metrics(memgov_overhead, st),
     }
     # full flag state once at report level (the per-record
     # flags_nondefault deltas are diffs against these defaults)
@@ -376,7 +392,8 @@ def main():
                  "numerics_off_overhead_ratio": 0.01,
                  "resilience_off_overhead_ratio": 0.01,
                  "serve_off_overhead_ratio": 0.01,
-                 "elastic_off_overhead_ratio": 0.01}
+                 "elastic_off_overhead_ratio": 0.01,
+                 "memgov_off_overhead_ratio": 0.01}
         # fixed FLOORS (ISSUE gates on ratios that must stay high):
         # coalescing must amortize dispatch >=3x across 16 clients
         fixed_min = {"serve_coalesced_speedup": 3.0}

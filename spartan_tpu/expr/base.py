@@ -34,6 +34,7 @@ from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
 from ..resilience import degrade as degrade_mod
 from ..resilience import faults as faults_mod
+from ..resilience import memory as memory_mod
 from ..utils import config as config_mod
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
@@ -707,7 +708,7 @@ class _Plan:
     first-run identity variant."""
 
     __slots__ = ("key", "traced", "out_tilings", "is_tuple", "arg_order",
-                 "report")
+                 "report", "governed_rung")
 
     def __init__(self, key: Tuple, traced: Callable,
                  out_tilings: Tuple[Tiling, ...], is_tuple: bool,
@@ -719,6 +720,11 @@ class _Plan:
         self.is_tuple = is_tuple
         self.arg_order = arg_order
         self.report = report
+        # set by the memory governor (resilience/memory.py) when this
+        # plan's predicted peak exceeded the HBM budget: hits re-route
+        # to the named ladder rung instead of dispatching a doomed
+        # executable. One attribute read per cache hit when ungoverned.
+        self.governed_rung: Optional[str] = None
 
 
 class _Exec:
@@ -1043,6 +1049,7 @@ def _opt_flags_key() -> Tuple:
                FLAGS.opt_fold_slices, FLAGS.placement,
                FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
                FLAGS.tiling_operand_move_weight,
+               FLAGS.tiling_memory_weight,
                bool(FLAGS.audit_numerics))
         _opt_key_memo = (ver, key)
     return key + (getattr(degrade_mod._TLS, "rung", None),)
@@ -1288,6 +1295,15 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
             if plan is not None:
                 prof.count("plan_hits")
                 esp.set(cache="hit")
+                if plan.governed_rung is not None:
+                    # the memory governor judged this plan over-budget
+                    # at build time: re-route to its rung (a rung-keyed
+                    # plan-cache hit) instead of dispatching a doomed
+                    # executable
+                    gov = memory_mod.redirect_governed(
+                        expr, plan, donated, mesh)
+                    if gov is not memory_mod.NOT_HANDLED:
+                        return gov
                 try:
                     return _dispatch(expr, plan, rctx.leaves,
                                      plan.arg_order, donated, mesh)
@@ -1313,6 +1329,18 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
             # result (cached sub-DAG frontier covered everything)
             expr._result = dag._result
             return dag._result
+
+        if plan.report is not None:
+            # predictive memory governor (resilience/memory.py): if the
+            # modeled peak exceeds the HBM budget, pick the cheapest
+            # sufficient ladder rung NOW — before this plan's first
+            # (doomed) compile+dispatch. NOT_HANDLED = within budget,
+            # no budget known, or governor off.
+            gov = memory_mod.maybe_degrade(expr, plan, plan_key,
+                                           donated, mesh)
+            if gov is not memory_mod.NOT_HANDLED:
+                dag._result = gov
+                return gov
 
         # this first run dispatches through the same path a hit takes,
         # with identity arg order over the OPTIMIZED leaves
@@ -1407,6 +1435,12 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
         raw_order = _arg_order(rctx.leaves, leaves)
     report = build_plan_report(expr, dag, leaves, plan_key,
                                passes_report, out_tilings, raw_order)
+    with prof.phase("memory_model"):
+        # the predictive memory governor's input: the modeled per-chip
+        # peak of THIS plan (resilience/memory.py), on the miss path
+        # only — one DAG walk next to an optimizer run + XLA compile
+        report["memory"] = memory_mod.estimate_report(dag, out_tilings,
+                                                      mesh)
     plan = _Plan(key, traced, out_tilings, is_tuple, identity, report)
 
     if rctx is not None and plan_key is not None:

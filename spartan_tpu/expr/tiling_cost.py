@@ -266,6 +266,17 @@ def _operand_move_weight() -> float:
     return w if w > 0 else _OPERAND_MOVE_WEIGHT
 
 
+def _memory_weight() -> float:
+    """Soft memory pressure (FLAGS.tiling_memory_weight, default 0):
+    bytes-equivalent penalty per byte of a candidate's PER-CHIP output
+    residency. Positive values bias the DP toward finer tilings —
+    the gentle end of the memory governor's spectrum (docs/MEMORY.md),
+    before a budget breach forces a whole degradation rung."""
+    from ..utils.config import FLAGS
+
+    return float(getattr(FLAGS, "tiling_memory_weight", 0.0) or 0.0)
+
+
 def _build_table(root: Expr, mesh) -> Dict:
     """Bottom-up candidate cost table:
     ``table[node_id][tiling] = (cost, per-child picks, strategy)``
@@ -274,6 +285,7 @@ def _build_table(root: Expr, mesh) -> Dict:
     weight = _compute_weight()
     flop_w = _flop_weight()
     move_w = _operand_move_weight()
+    mem_w = _memory_weight()
 
     def nbytes(e: Expr) -> float:
         return float(e.size) * e.dtype.itemsize
@@ -313,6 +325,11 @@ def _build_table(root: Expr, mesh) -> Dict:
         kids = node.children()
         cview = _contraction_view(node)
         for t in candidates(node, mesh):
+            # soft memory term: per-chip output residency of this
+            # candidate, charged on contraction and non-contraction
+            # nodes alike (0 when the weight flag is off)
+            memcost = (mem_w * nbytes(node) / _parallelism(t, mesh)
+                       if mem_w else 0.0)
             compute = (nbytes(node) * weight
                        / _parallelism(t, mesh))
             if cview is not None:
@@ -346,7 +363,7 @@ def _build_table(root: Expr, mesh) -> Dict:
                     # best_child (critical path before the matmul —
                     # see _OPERAND_MOVE_WEIGHT); the epsilon keeps
                     # exact ties deterministic
-                    tot = (ca + cb + psum + fl
+                    tot = (ca + cb + psum + fl + memcost
                            + (ma + mb) * _OP_MOVE_EPS)
                     if best is None or tot < best[0]:
                         best = (tot, (pa, pb), s)
@@ -359,7 +376,7 @@ def _build_table(root: Expr, mesh) -> Dict:
                 ccost, pick, _ = best_child(c, req)
                 comm += ccost
                 picks.append(pick)
-            entries[t] = (comm + compute, tuple(picks), None)
+            entries[t] = (comm + compute + memcost, tuple(picks), None)
         table[node._id] = entries
 
     roots = root.elements if isinstance(root, TupleExpr) else (root,)

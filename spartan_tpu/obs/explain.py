@@ -39,6 +39,18 @@ def _label(node: Any) -> str:
     return f"{type(node).__name__}#{node._id}"
 
 
+def _fmt_bytes(n: Any) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
 def _site_str(site: Optional[Tuple[str, int, str]]) -> Optional[str]:
     return f"{site[0]}:{site[1]} (in {site[2]})" if site else None
 
@@ -285,11 +297,40 @@ class ExplainReport:
             lines.append(
                 f"  donation: args {don['last_donated_args']} donated "
                 f"({don['donated_dispatches']} donated dispatch(es))")
+        mem = d.get("memory")
+        if mem:
+            line = (f"  memory: predicted peak "
+                    f"{_fmt_bytes(mem.get('peak_bytes_per_chip'))}/chip")
+            if mem.get("budget_bytes"):
+                line += f" (budget {_fmt_bytes(mem['budget_bytes'])})"
+            if mem.get("governed_rung"):
+                line += (f", GOVERNED -> rung {mem['governed_rung']}")
+                if mem.get("governed_peak_bytes"):
+                    line += (f" predicted "
+                             f"{_fmt_bytes(mem['governed_peak_bytes'])}")
+            lines.append(line)
+            for top in (mem.get("top") or [])[:5]:
+                lines.append(f"    {top['node']:<28} "
+                             f"{_fmt_bytes(top['bytes'])}")
+            val = mem.get("validation")
+            if val:
+                lines.append(
+                    f"    validated: xla peak "
+                    f"{_fmt_bytes(val.get('xla_peak_bytes'))}, "
+                    f"predicted/actual {val.get('error_ratio')}")
         res = d.get("resilience")
         if res:
             line = f"  resilience: retries={res.get('retries', 0)}"
             if res.get("rung"):
                 line += f", degraded rung={res['rung']}"
+                # a PREDICTIVE pick (memory governor, before any
+                # dispatch) must be distinguishable from a REACTIVE
+                # one (after a real OOM) in bug reports
+                line += f" ({res.get('origin', 'reactive')}"
+                if res.get("rung_predicted_bytes") is not None:
+                    line += (", predicted "
+                             f"{_fmt_bytes(res['rung_predicted_bytes'])}")
+                line += ")"
             if res.get("restores"):
                 line += f", loop restores={res['restores']}"
             if res.get("resumed_from") is not None:

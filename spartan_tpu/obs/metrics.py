@@ -284,21 +284,58 @@ class Registry:
 REGISTRY = Registry()
 
 
-def _update_device_gauges() -> None:
-    """Record device memory gauges (high-water tracked by the Gauge)
-    where the backend exposes ``memory_stats`` (TPU does; CPU mostly
-    returns None)."""
+def device_memory_aggregate() -> Dict[str, Dict[str, float]]:
+    """Memory stats aggregated across ALL local devices: per key the
+    ``max`` (the honest multi-chip high-water — the chip that OOMs
+    first) and the ``sum`` (total footprint). The single sanctioned
+    ``memory_stats`` read-out next to ``parallel/mesh.status`` and
+    ``resilience/memory`` (lint rule 8 ``raw-memory-stats``); empty on
+    backends without memory_stats (CPU)."""
+    agg: Dict[str, Dict[str, float]] = {}
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats() or {}
+        devices = jax.local_devices()
     except Exception:
-        return
+        return agg
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            continue
+        for key, v in stats.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            cur = agg.get(key)
+            if cur is None:
+                agg[key] = {"max": v, "sum": v}
+            else:
+                cur["max"] = max(cur["max"], v)
+                cur["sum"] += v
+    return agg
+
+
+def _update_device_gauges() -> None:
+    """Record device memory gauges (high-water tracked by the Gauge)
+    where the backend exposes ``memory_stats`` (TPU does; CPU mostly
+    returns None). ``device_<key>`` is the MAX across all local
+    devices — reading only device 0 hid the hottest chip's high-water
+    on multi-chip hosts — and ``device_<key>_total`` is the sum."""
+    aggregate = device_memory_aggregate()
     for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-        if key in stats:
-            REGISTRY.gauge(
-                "device_" + key,
-                "jax device memory stat " + key).set(float(stats[key]))
+        agg = aggregate.get(key)
+        if agg is None:
+            continue
+        REGISTRY.gauge(
+            "device_" + key,
+            "jax device memory stat " + key + " (max across local "
+            "devices)").set(agg["max"])
+        REGISTRY.gauge(
+            "device_" + key + "_total",
+            "jax device memory stat " + key + " (sum across local "
+            "devices)").set(agg["sum"])
 
 
 def snapshot(fmt: str = "json") -> Any:

@@ -299,9 +299,24 @@ def status() -> dict:
 
     mesh = get_mesh()
     devs = jax.devices()
-    mem = {}
+    # memory_stats aggregated across ALL local devices — the reading
+    # from device 0 alone hid the hottest chip's high-water on
+    # multi-chip hosts. Per key: max (the chip that OOMs first) + sum.
+    mem: dict = {}
     try:
-        mem = dict(jax.local_devices()[0].memory_stats() or {})
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            for key, v in stats.items():
+                try:
+                    v = float(v)
+                except (TypeError, ValueError):
+                    continue
+                cur = mem.get(key)
+                if cur is None:
+                    mem[key] = {"max": v, "sum": v}
+                else:
+                    cur["max"] = max(cur["max"], v)
+                    cur["sum"] += v
     except Exception:
         pass
     return {

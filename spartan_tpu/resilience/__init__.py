@@ -28,6 +28,13 @@ package makes every failure a tested, observable code path:
   checkpoint_path=p, resume=p)``: atomic periodic carry snapshots,
   restore-on-failure, cross-process resume reproducing the
   uninterrupted run bit-for-bit.
+* :mod:`memory` — the PREDICTIVE memory governor: a per-chip live-set
+  model of every plan's peak HBM (built at plan time, validated
+  against XLA's ``memory_analysis``), rung selection BEFORE the first
+  dispatch when the prediction exceeds ``FLAGS.hbm_budget_bytes``
+  (auto-detected from device ``memory_stats``), and the serve
+  engine's in-flight reservation ledger. The reactive ladder above
+  stays as the fallback when the model was wrong. docs/MEMORY.md.
 * :mod:`elastic` — the terminal rung: on persistent device/host loss
   (``fatal_mesh``: ``DATA_LOSS`` / halted-client statuses, or the
   injected ``device_loss`` chaos fault) drain the serve engine,
@@ -42,7 +49,7 @@ how-to. Import discipline: this package sits below the expr layer
 are reached lazily.
 """
 
-from . import classify, degrade, elastic, engine, faults, loop_ckpt
+from . import classify, degrade, elastic, engine, faults, loop_ckpt, memory
 from .classify import (DETERMINISTIC, FATAL_MESH, IO, OOM, STALE_MESH,
                        TRANSIENT, FatalMeshError,
                        classify as classify_error)
@@ -59,4 +66,5 @@ __all__ = [
     "InjectedCompileError", "InjectedCheckpointError",
     "InjectedDeviceLossError",
     "classify", "degrade", "elastic", "engine", "faults", "loop_ckpt",
+    "memory",
 ]

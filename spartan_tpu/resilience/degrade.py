@@ -175,6 +175,26 @@ def _replan_evaluate(expr: Any, donated: List[Any], rung: str) -> Any:
         return base.evaluate(clone, donate=donated)
 
 
+def rung_predicted_bytes(expr: Any, rung: str, mesh) -> Optional[int]:
+    """The memory governor's modeled peak for ``rung``'s re-plan of
+    ``expr`` — recorded on the resilience record next to the rung so a
+    PREDICTIVE pick is distinguishable from a REACTIVE one in bug
+    reports (``st.explain`` prints both). A plan-cache read in the
+    common case (the rung's clone was just evaluated)."""
+    from ..expr import base
+
+    clone = clone_for_replan(expr)
+    with _RungCtx(rung):
+        plan_key, _rctx = base.plan_signature(clone, mesh)
+        plan = base.lookup_plan(plan_key)
+    if plan is None or plan.report is None:
+        return None
+    mem = plan.report.get("memory")
+    if not mem:
+        return None
+    return int(mem["peak_bytes_per_chip"])
+
+
 # -- rung 3: chunked row-block evaluation -------------------------------
 
 
@@ -267,6 +287,14 @@ def run_ladder(exc: BaseException, expr: Any, donated: List[Any],
             continue
         rec["rung"] = rung
         rec["degraded"] = True
+        rec["origin"] = "reactive"  # vs "predictive" (memory governor)
+        if rung != "chunked":
+            try:  # the rung's modeled peak, next to the rung taken
+                predicted = rung_predicted_bytes(expr, rung, mesh)
+                if predicted is not None:
+                    rec["rung_predicted_bytes"] = predicted
+            except Exception:
+                pass  # advisory: never mask a successful degradation
         _count(rung)
         expr._result = result
         expr._resilience = rec

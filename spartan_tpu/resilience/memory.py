@@ -1,0 +1,693 @@
+"""Predictive memory governor: model peak HBM per plan, gate admission,
+degrade BEFORE dying.
+
+Every memory defense before this module was reactive: the OOM ladder
+(:mod:`resilience.degrade`) fires only after XLA throws
+RESOURCE_EXHAUSTED — wasting a compile + dispatch per rung — and the
+serve engine admitted requests with no idea whether their combined
+working sets fit in HBM. This module makes the plan's memory
+high-water a *modeled* quantity (ROADMAP item 3's "predict instead of
+react") with three consumers:
+
+1. **The model** (:func:`estimate_report`, run at ``_build_plan`` time
+   and stored on ``_Plan.report["memory"]``): a per-chip live-set
+   schedule over the optimized DAG's topological order. Per node:
+   output bytes under its chosen (sanitized) tiling, freed when its
+   last consumer has been emitted; leaf arguments resident throughout;
+   reshard staging priced by the same layout fractions as
+   ``expr/tiling_cost.reshard_cost`` (a resharded operand materializes
+   a destination-shard copy); reduces charge a pre-reduce
+   operand-sized intermediate (the fused map->reduce tree is
+   materialized at input size); contractions charge
+   ``max(psum partial, reshard staging)`` — XLA overlaps the gathered
+   operand with the partial's buffer, so summing both double-counts;
+   ``lax.while_loop`` carries are double-buffered (old + new live
+   across the condition read) while plain ``fori_loop`` map-bodies
+   alias in place. Donation credits (aliasable donated-argument bytes)
+   subtract at enforcement time. Validated against XLA's
+   ``compiled.memory_analysis()`` (:func:`validate_plan`), with
+   predicted-vs-actual recorded in the ``memory_prediction_error_ratio``
+   metric.
+
+2. **Predictive degradation** (:func:`maybe_degrade`, called by
+   ``evaluate()`` before the FIRST dispatch of a plan-cache miss; plus
+   :func:`redirect_governed` on hits of a plan already judged
+   over-budget): if the predicted peak exceeds the budget
+   (``FLAGS.hbm_budget_bytes``, auto-detected from device
+   ``memory_stats`` when 0), the cheapest sufficient ladder rung is
+   chosen UP FRONT by re-running the estimator against each rung's
+   cloned re-plan — the happy path never burns a doomed compile, and
+   the result is bit-identical to the reactively-degraded path (both
+   evaluate the same rung-forced clone). Reactive retry
+   (:func:`degrade.run_ladder`) stays as the fallback when the model
+   was wrong.
+
+3. **Memory-aware admission** (:func:`request_bytes`, consumed by
+   ``serve/engine.py``'s reservation ledger): each in-flight dispatch
+   reserves its predicted peak; submissions whose prediction would
+   overflow the budget are rejected with ``Backpressure`` instead of
+   an OOM that trips the whole engine.
+
+Known blind spots (docs/MEMORY.md): XLA's fusion/rematerialization
+decisions are approximated, serve-coalesced batch variants scale the
+reservation linearly with batch size rather than re-modeling the
+vmapped program, and auto-detected budgets require a backend that
+implements ``memory_stats`` (TPU does; CPU returns None, leaving the
+governor inert unless ``FLAGS.hbm_budget_bytes`` is set).
+
+Imports only config/obs/resilience layers at module level (expr/array
+load lazily inside functions), mirroring :mod:`resilience.degrade` —
+``expr/base`` binds this module at import time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY
+from ..utils import profiling as prof
+from ..utils.config import FLAGS
+from ..utils.log import log_debug, log_warn
+from . import degrade
+
+_GOVERNOR_FLAG = FLAGS.define_bool(
+    "memory_governor", True,
+    "Master switch for the predictive memory governor: estimate every "
+    "plan's peak per-chip HBM at build time, pick an OOM-ladder rung "
+    "BEFORE the first dispatch when the prediction exceeds the budget, "
+    "and gate serve admission on the in-flight reservation ledger. "
+    "Inert when no budget is known (hbm_budget_bytes=0 on a backend "
+    "without memory_stats, e.g. CPU). Off = the PR-5 reactive ladder "
+    "only.")
+_BUDGET_FLAG = FLAGS.define_int(
+    "hbm_budget_bytes", 0,
+    "Per-chip HBM budget the governor enforces. 0 = auto-detect from "
+    "the smallest bytes_limit across local devices' memory_stats "
+    "(None on backends without memory_stats: governor inert). "
+    "Override for tests or to leave headroom below the physical "
+    "limit.")
+
+# sentinel: the governor declined to act; evaluate() proceeds normally
+NOT_HANDLED = object()
+
+# (mutation_count, mesh epoch) -> budget. Auto-detection probes every
+# local device; memoize on flag state + mesh epoch so the hot path
+# pays two int compares.
+_budget_lock = threading.Lock()
+_budget_memo: Tuple[Optional[Tuple[int, int]], Optional[int]] = (None, None)
+
+
+def _detect_budget() -> Optional[int]:
+    """Smallest bytes_limit across local devices (the chip that OOMs
+    first bounds the single-program step), or None when the backend
+    exposes no memory_stats."""
+    try:
+        import jax
+
+        limits = []
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            if "bytes_limit" in stats:
+                limits.append(int(stats["bytes_limit"]))
+        return min(limits) if limits else None
+    except Exception:
+        return None
+
+
+def hbm_budget_bytes() -> Optional[int]:
+    """The enforced per-chip budget: ``FLAGS.hbm_budget_bytes`` when
+    set, else the auto-detected device limit, else None (no governing
+    possible)."""
+    global _budget_memo
+    from ..parallel import mesh as mesh_mod
+    from ..utils import config as config_mod
+
+    ver = (config_mod.mutation_count(), mesh_mod._EPOCH)
+    memo_ver, budget = _budget_memo
+    if memo_ver == ver:
+        return budget
+    explicit = _BUDGET_FLAG._value
+    budget = int(explicit) if explicit else _detect_budget()
+    with _budget_lock:
+        _budget_memo = (ver, budget)
+    return budget
+
+
+# -- the estimator -------------------------------------------------------
+
+
+def _shard_bytes(shape, dtype, tiling, mesh) -> float:
+    """Per-chip bytes of ``shape``/``dtype`` laid out as ``tiling``."""
+    import numpy as np
+
+    from ..array import tiling as tiling_mod
+    from ..expr.tiling_cost import _parallelism
+
+    nbytes = float(int(np.prod(shape)) if shape else 1) \
+        * np.dtype(dtype).itemsize
+    t = tiling_mod.sanitize(tiling, shape, mesh)
+    return nbytes / _parallelism(t, mesh)
+
+
+def _node_shard_bytes(n: Any, mesh) -> float:
+    from ..array import tiling as tiling_mod
+
+    try:
+        t = n.out_tiling()
+    except Exception:
+        t = tiling_mod.replicated(n.ndim)
+    return _shard_bytes(n.shape, n.dtype, t, mesh)
+
+
+def _staging_bytes(child: Any, req, mesh) -> float:
+    """Destination-shard bytes a reshard edge materializes: the same
+    per-axis layout fractions as ``tiling_cost.reshard_cost`` (zero
+    when no wire traffic moves — same layout, or replicated source
+    already covering the destination)."""
+    import numpy as np
+
+    from ..expr.tiling_cost import reshard_cost
+
+    try:
+        src = child.out_tiling()
+    except Exception:
+        return 0.0
+    if src.axes == req.axes:
+        return 0.0
+    nbytes = float(child.size) * np.dtype(child.dtype).itemsize
+    if reshard_cost(src, req, nbytes, mesh) <= 0.0:
+        return 0.0  # e.g. replicated source: shards carved locally
+    return _shard_bytes(child.shape, child.dtype, req, mesh)
+
+
+def estimate_dag(dag: Any, out_tilings, mesh) -> Dict[str, Any]:
+    """The per-chip live-set schedule (module docstring, consumer 1).
+
+    Walks the optimized DAG in topological (post-) order simulating
+    buffer lifetimes: a node's output shard is allocated at its emit
+    and freed when its last consumer has been emitted; per-node
+    transients (reduce intermediates, contraction partials, reshard
+    staging, while-loop double buffers) are live only across the emit.
+    Returns the peak, its components, and the top contributors at the
+    peak step (the ``st.explain`` surface)."""
+    from ..expr.base import ScalarExpr, TupleExpr, ValExpr
+    from ..expr.loop import CarryExpr, LoopExpr
+    from ..expr.map import MapExpr
+    from ..expr.map2 import Map2Expr
+    from ..expr.optimize import dag_nodes
+    from ..expr.reduce import GeneralReduceExpr, ReduceExpr
+    from ..expr.tiling_cost import _contraction_view, _operand_requirement
+
+    nodes = dag_nodes(dag)
+    roots = dag.elements if isinstance(dag, TupleExpr) else (dag,)
+    root_ids = {r._id for r in roots}
+
+    # bytes each node's output occupies once emitted (0 for nodes whose
+    # storage is accounted elsewhere: leaves ride args, a TupleExpr is
+    # its elements, a LoopExpr's carries ride its init args, and a
+    # fori_loop's elementwise body root computes in place)
+    alias_free: set = set()
+    for n in nodes:
+        if isinstance(n, LoopExpr) and not n.early_exit:
+            for b in n.body_roots:
+                if isinstance(b, MapExpr):
+                    alias_free.add(b._id)
+
+    args_bytes = 0.0
+    leaf_entries: List[Tuple[str, float]] = []
+    out_map: Dict[int, float] = {}
+    for r, t in zip(roots, out_tilings):
+        out_map[r._id] = _shard_bytes(r.shape, r.dtype, t, mesh)
+    out_bytes = sum(out_map.values())
+
+    size_of: Dict[int, float] = {}
+    for n in nodes:
+        if isinstance(n, (ValExpr, ScalarExpr)):
+            b = _node_shard_bytes(n, mesh)
+            args_bytes += b
+            leaf_entries.append((f"{type(n).__name__}#{n._id} "
+                                 f"{n.shape}", b))
+            size_of[n._id] = 0.0  # resident via args_bytes
+        elif isinstance(n, (CarryExpr, TupleExpr, LoopExpr)):
+            size_of[n._id] = 0.0
+        elif n._id in alias_free:
+            size_of[n._id] = 0.0
+        elif n._id in out_map:
+            size_of[n._id] = out_map[n._id]
+        else:
+            size_of[n._id] = _node_shard_bytes(n, mesh)
+
+    def transient(n: Any) -> float:
+        kids = n.children()
+        if isinstance(n, LoopExpr):
+            if not n.early_exit:
+                return 0.0
+            # while_loop: old + new carry live across the condition
+            return 2.0 * sum(
+                _node_shard_bytes(b, mesh) for b in n.body_roots)
+        if isinstance(n, Map2Expr):
+            # opaque user kernel: the DAG cannot see its internal
+            # temporaries (e.g. k-means' (n, k) distance matrix), so
+            # charge the defensible FLOOR — the kernel at least reads
+            # every operand. A known under-estimation class
+            # (docs/MEMORY.md "blind spots").
+            return sum(_node_shard_bytes(c, mesh) for c in kids)
+        if isinstance(n, (ReduceExpr, GeneralReduceExpr)) and kids:
+            # the fused pre-reduce tree materializes at operand size
+            pre = getattr(n, "_pre_shape", None) or kids[0].shape
+            best = 0.0
+            for c in kids:
+                try:
+                    t = c.out_tiling()
+                except Exception:
+                    continue
+                best = max(best, _shard_bytes(pre, c.dtype, t, mesh))
+            return best
+        cview = _contraction_view(n)
+        if cview is not None and len(kids) >= 2:
+            partial = _node_shard_bytes(n, mesh)
+            staging = 0.0
+            plan = getattr(n, "_dot_plan", None)
+            reqs = None
+            if plan is not None:
+                try:
+                    reqs = cview[1](plan[0], plan[1])
+                except Exception:
+                    reqs = None
+            if reqs is not None:
+                for c, req in zip(kids, reqs):
+                    staging += _staging_bytes(c, req, mesh)
+            # XLA reuses the gathered operand's buffer for the partial
+            return max(partial, staging)
+        staging = 0.0
+        try:
+            t = n.out_tiling()
+        except Exception:
+            return 0.0
+        for i, c in enumerate(kids):
+            try:
+                req = _operand_requirement(n, t, c, i)
+            except Exception:
+                req = None
+            if req is not None:
+                staging += _staging_bytes(c, req, mesh)
+        return staging
+
+    refs: Dict[int, int] = {}
+    for n in nodes:
+        for c in n.children():
+            refs[c._id] = refs.get(c._id, 0) + 1
+
+    live: Dict[int, Tuple[str, float]] = {}
+    live_sum = 0.0
+    peak = 0.0
+    peak_top: List[Tuple[str, float]] = []
+    for n in nodes:
+        tr = transient(n)
+        here = live_sum + size_of[n._id] + tr
+        if args_bytes + here > args_bytes + peak:
+            peak = here
+            peak_top = sorted(
+                [(f"{type(n).__name__}#{n._id} {n.shape}",
+                  size_of[n._id] + tr)]
+                + list(live.values()) + leaf_entries,
+                key=lambda kv: -kv[1])[:5]
+        if size_of[n._id] > 0:
+            live[n._id] = (f"{type(n).__name__}#{n._id} {n.shape}",
+                           size_of[n._id])
+            live_sum += size_of[n._id]
+        for c in n.children():
+            refs[c._id] -= 1
+            if refs[c._id] == 0 and c._id in live and \
+                    c._id not in root_ids:
+                live_sum -= live.pop(c._id)[1]
+
+    total = args_bytes + peak
+    return {
+        "peak_bytes_per_chip": int(total),
+        "args_bytes": int(args_bytes),
+        "out_bytes": int(out_bytes),
+        "temp_bytes": int(max(0.0, total - args_bytes - out_bytes)),
+        "top": [{"node": k, "bytes": int(v)} for k, v in peak_top],
+    }
+
+
+def estimate_report(dag: Any, out_tilings, mesh) -> Optional[Dict]:
+    """``_build_plan``'s entry point: the estimate dict stored on
+    ``_Plan.report["memory"]`` (plus budget context and the
+    ``memory_predicted_bytes`` gauge). Advisory — a modeling failure
+    on an exotic DAG returns None rather than failing the plan."""
+    try:
+        mem = estimate_dag(dag, out_tilings, mesh)
+    except Exception as e:  # noqa: BLE001 - the model is advisory
+        log_debug("memory governor: estimate failed (%s: %s)",
+                  type(e).__name__, e)
+        return None
+    mem["budget_bytes"] = hbm_budget_bytes()
+    mem["governed_rung"] = None
+    if _METRICS_FLAG._value:
+        REGISTRY.gauge(
+            "memory_predicted_bytes",
+            "modeled peak per-chip bytes of the most recently built "
+            "plan (high-water tracked)").set(
+                float(mem["peak_bytes_per_chip"]))
+    return mem
+
+
+def donation_credit(mem: Dict[str, Any], donated: List[Any],
+                    mesh) -> float:
+    """Bytes the budget check may discount when the dispatch donates
+    buffers: XLA can alias donated argument HBM into the outputs, so
+    up to ``out_bytes`` of donated-shard bytes never double-occupy."""
+    if not donated:
+        return 0.0
+    credit = 0.0
+    for arr in donated:
+        try:
+            credit += _shard_bytes(arr.shape, arr.dtype, arr.tiling,
+                                   mesh)
+        except Exception:
+            continue
+    return min(credit, float(mem.get("out_bytes", 0)))
+
+
+# -- validation against XLA ---------------------------------------------
+
+
+def _sharded_specs(plan: Any, mesh) -> Optional[List[Any]]:
+    """Abstract args matching what ``_dispatch`` actually feeds: the
+    report's arg specs with each array leaf's sharding attached (the
+    plain specs compile an unsharded program whose memory bears no
+    relation to the distributed dispatch)."""
+    import jax
+
+    from ..array import tiling as tiling_mod
+
+    report = plan.report or {}
+    raw = report.get("arg_specs")
+    leaves = report.get("leaves")
+    if raw is None or leaves is None or len(raw) != len(leaves):
+        return None
+    specs: List[Any] = []
+    for spec, leaf in zip(raw, leaves):
+        if leaf.get("kind") == "scalar":
+            specs.append(spec)  # the recorded python scalar
+            continue
+        axes = leaf.get("tiling")
+        if axes is None:
+            return None
+        t = tiling_mod.sanitize(
+            tiling_mod.Tiling(axes), leaf["shape"], mesh)
+        specs.append(jax.ShapeDtypeStruct(
+            spec.shape, spec.dtype, sharding=t.sharding(mesh)))
+    return specs
+
+
+def validate_plan(plan: Any, mesh=None,
+                  donate_pos: Tuple[int, ...] = ()) -> Optional[Dict]:
+    """Compare the model against XLA's ``compiled.memory_analysis()``.
+
+    AOT-compiles the plan's traced function over SHARDED arg specs
+    (one extra compile — validation is a test/benchmark/debug surface,
+    never on the dispatch path) and records
+    ``memory_prediction_error_ratio`` = predicted / actual. Returns
+    None when the backend exposes no memory analysis."""
+    import jax
+
+    from ..parallel import mesh as mesh_mod
+
+    if plan is None or plan.report is None:
+        return None
+    mem = plan.report.get("memory")
+    if not mem:
+        return None
+    if mesh is None:
+        mesh = mesh_mod.get_mesh()
+    specs = _sharded_specs(plan, mesh)
+    if specs is None:
+        return None
+    try:
+        jitted = (jax.jit(plan.traced,
+                          donate_argnums=tuple(sorted(donate_pos)))
+                  if donate_pos else jax.jit(plan.traced))
+        with prof.phase("memory_validate"):
+            compiled = jitted.lower(*specs).compile()
+            ma = compiled.memory_analysis()
+    except Exception as e:  # backend without AOT memory analysis
+        log_debug("memory governor: validation unavailable (%s)", e)
+        return None
+    if ma is None:
+        return None
+    try:
+        actual = int(ma.argument_size_in_bytes
+                     + ma.output_size_in_bytes
+                     + ma.temp_size_in_bytes
+                     - ma.alias_size_in_bytes)
+        alias = int(ma.alias_size_in_bytes)
+    except AttributeError:
+        return None
+    predicted = int(mem["peak_bytes_per_chip"]) - int(min(
+        alias, mem.get("out_bytes", 0)))
+    ratio = (predicted / actual) if actual > 0 else None
+    result = {
+        "xla_peak_bytes": actual,
+        "xla_argument_bytes": int(ma.argument_size_in_bytes),
+        "xla_output_bytes": int(ma.output_size_in_bytes),
+        "xla_temp_bytes": int(ma.temp_size_in_bytes),
+        "xla_alias_bytes": alias,
+        "predicted_bytes": predicted,
+        "error_ratio": (round(ratio, 4) if ratio is not None else None),
+    }
+    mem["validation"] = result
+    if _METRICS_FLAG._value and ratio is not None:
+        REGISTRY.counter(
+            "memory_validations",
+            "plans validated against XLA memory_analysis").inc()
+        REGISTRY.gauge(
+            "memory_prediction_error_ratio",
+            "predicted / XLA-reported peak bytes of the last validated "
+            "plan (1.0 = exact; high-water tracks the worst "
+            "overprediction)").set(float(ratio))
+    return result
+
+
+def predict(expr: Any, mesh=None) -> Optional[Dict]:
+    """Public helper: the memory estimate for ``expr``'s plan (builds
+    and caches the plan without dispatching, like ``st.explain``)."""
+    from ..expr import base
+    from ..parallel import mesh as mesh_mod
+
+    if mesh is None:
+        mesh = mesh_mod.get_mesh()
+    root = expr if isinstance(expr, base.Expr) else base.as_expr(expr)
+    if root._result is not None:
+        return None
+    plan_key, rctx = base.plan_signature(root, mesh)
+    plan = base.lookup_plan(plan_key)
+    if plan is None:
+        plan, _dag, _ = base._build_plan(root, mesh, rctx, plan_key)
+    if plan is None or plan.report is None:
+        return None
+    return plan.report.get("memory")
+
+
+# -- predictive degradation (consumer 2) ---------------------------------
+
+
+def _count(name: str, help_: str) -> None:
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(name, help_).inc()
+
+
+def _rung_estimate(expr: Any, rung: str, mesh
+                   ) -> Tuple[Optional[Any], Optional[int]]:
+    """Build (or look up) the rung's re-planned clone WITHOUT
+    compiling or dispatching, and read its modeled peak. The plan is
+    cached under the rung-keyed signature, so the follow-up
+    ``_replan_evaluate`` hits it — choosing a rung costs one optimizer
+    pass stack per rung, never a doomed XLA compile."""
+    from ..expr import base
+
+    clone = degrade.clone_for_replan(expr)
+    with degrade._RungCtx(rung):
+        plan_key, rctx = base.plan_signature(clone, mesh)
+        plan = base.lookup_plan(plan_key)
+        if plan is None:
+            plan, _dag, _ = base._build_plan(clone, mesh, rctx,
+                                             plan_key)
+    if plan is None or plan.report is None:
+        return None, None
+    mem = plan.report.get("memory")
+    if not mem:
+        return plan, None
+    return plan, int(mem["peak_bytes_per_chip"])
+
+
+def choose_rung(expr: Any, mesh, budget: int
+                ) -> Tuple[Optional[str], Optional[int]]:
+    """The cheapest sufficient ladder rung for ``expr`` under
+    ``budget``: the estimator re-runs against each rung's cloned
+    re-plan, in ladder order (each rung trades more speed away), and
+    the first rung predicted to fit wins. ``chunked`` is the
+    unmodeled last resort (peak ~ one row block) when it applies."""
+    from ..expr.base import TupleExpr
+
+    for rung in ("finer_tiling", "fusion_off"):
+        _plan, peak = _rung_estimate(expr, rung, mesh)
+        if peak is not None and peak <= budget:
+            return rung, peak
+    if (not isinstance(expr, TupleExpr) and expr.ndim > 0
+            and int(expr.shape[0]) >= 2):
+        return "chunked", None
+    return None, None
+
+
+def _record_predictive(expr: Any, plan: Any, rung: str,
+                       rung_peak: Optional[int]) -> Dict[str, Any]:
+    from .engine import _resilience_record
+
+    rec = _resilience_record(expr, plan)
+    rec["rung"] = rung
+    rec["degraded"] = True
+    rec["origin"] = "predictive"
+    if rung_peak is not None:
+        rec["rung_predicted_bytes"] = int(rung_peak)
+    mem = (plan.report or {}).get("memory")
+    if mem:
+        mem["governed_rung"] = rung
+        if rung_peak is not None:
+            mem["governed_peak_bytes"] = int(rung_peak)
+    return rec
+
+
+def _evaluate_rung(expr: Any, rung: str, donated: List[Any], mesh,
+                   plan: Any) -> Any:
+    """Dispatch the chosen rung; an OOM despite the model (the
+    prediction was wrong) falls back to the REACTIVE ladder."""
+    from . import classify as classify_mod
+
+    try:
+        if rung == "chunked":
+            with prof.span("degrade", rung=rung, origin="predictive"):
+                return degrade._chunked_evaluate(expr, mesh)
+        with prof.span("degrade", rung=rung, origin="predictive"):
+            return degrade._replan_evaluate(expr, donated, rung)
+    except degrade.NotApplicable:
+        raise
+    except Exception as e:  # noqa: BLE001 - fall back to the ladder
+        if classify_mod.classify(e) != classify_mod.OOM:
+            raise
+        log_warn("memory governor: predicted rung %r still OOMed; "
+                 "falling back to the reactive ladder", rung)
+        return degrade.run_ladder(e, expr, donated, mesh, plan)
+
+
+def maybe_degrade(expr: Any, plan: Any, plan_key: Any,
+                  donated: List[Any], mesh) -> Any:
+    """The plan-cache-MISS enforcement point (``evaluate()`` calls
+    this after ``_build_plan``, before the first dispatch). Returns
+    the evaluated result when the governor degraded predictively, or
+    :data:`NOT_HANDLED` to proceed with the normal dispatch."""
+    if not _GOVERNOR_FLAG._value or not FLAGS.oom_degrade:
+        return NOT_HANDLED
+    if degrade.active_rung() is not None:
+        return NOT_HANDLED  # already inside a degraded re-plan
+    mem = plan.report.get("memory") if plan.report else None
+    if not mem:
+        return NOT_HANDLED
+    budget = hbm_budget_bytes()
+    if not budget:
+        return NOT_HANDLED
+    need = mem["peak_bytes_per_chip"] - donation_credit(
+        mem, donated, mesh)
+    if need <= budget:
+        return NOT_HANDLED
+    rung, rung_peak = choose_rung(expr, mesh, budget)
+    if rung is None:
+        # nothing the ladder can express fits the budget: dispatch and
+        # let the reactive path fight the (possibly real) OOM
+        _count("memory_governor_unsatisfiable",
+               "over-budget plans no ladder rung could bring under "
+               "the budget (dispatched anyway)")
+        return NOT_HANDLED
+    log_warn("memory governor: predicted peak %.1f MiB > budget "
+             "%.1f MiB; degrading to rung %r BEFORE dispatch",
+             need / 2 ** 20, budget / 2 ** 20, rung)
+    _count("resilience_predictive_degrades",
+           "plans degraded predictively (before any dispatch/OOM)")
+    rec = _record_predictive(expr, plan, rung, rung_peak)
+    # later structurally-identical evaluates hit the UNGOVERNED plan:
+    # mark both the identity plan and its cached twin so the hit path
+    # redirects without re-estimating
+    from ..expr import base
+
+    plan.governed_rung = rung
+    if plan_key is not None:
+        stored = base.lookup_plan(plan_key)
+        if stored is not None:
+            stored.governed_rung = rung
+    try:
+        result = _evaluate_rung(expr, rung, donated, mesh, plan)
+    except degrade.NotApplicable:
+        return NOT_HANDLED
+    expr._result = result
+    expr._resilience = rec
+    return result
+
+
+def redirect_governed(expr: Any, plan: Any, donated: List[Any],
+                      mesh) -> Any:
+    """The plan-cache-HIT enforcement point: a plan already judged
+    over-budget (``plan.governed_rung``) re-routes to its rung —
+    steady state costs one clone + signature (a rung-keyed plan-cache
+    hit), never a doomed dispatch. Falls through when the governor or
+    budget has since been turned off."""
+    if not _GOVERNOR_FLAG._value or not FLAGS.oom_degrade:
+        return NOT_HANDLED
+    if degrade.active_rung() is not None:
+        return NOT_HANDLED
+    if not hbm_budget_bytes():
+        return NOT_HANDLED
+    rung = plan.governed_rung
+    _count("memory_governor_redirects",
+           "plan-cache hits re-routed to their governed rung")
+    rec = _record_predictive(expr, plan, rung, (plan.report or {}).get(
+        "memory", {}).get("governed_peak_bytes"))
+    try:
+        result = _evaluate_rung(expr, rung, donated, mesh, plan)
+    except degrade.NotApplicable:
+        return NOT_HANDLED
+    expr._result = result
+    expr._resilience = rec
+    return result
+
+
+# -- serve admission (consumer 3) ----------------------------------------
+
+
+def request_bytes(plan: Any, leaves: List[Any], mesh) -> int:
+    """Predicted per-chip peak for one serve request: the plan
+    report's effective peak (the governed rung's, when one was
+    chosen) — or, before the plan exists, the leaf-argument floor
+    (every dispatch at least holds its inputs)."""
+    mem = None
+    if plan is not None and plan.report is not None:
+        mem = plan.report.get("memory")
+    if mem:
+        return int(mem.get("governed_peak_bytes")
+                   or mem["peak_bytes_per_chip"])
+    from ..expr.base import _leaf_array
+
+    floor = 0.0
+    for leaf in leaves:
+        arr = _leaf_array(leaf)
+        if arr is None:
+            continue
+        try:
+            floor += _shard_bytes(arr.shape, arr.dtype, arr.tiling,
+                                  mesh)
+        except Exception:
+            continue
+    return int(floor)

@@ -7,7 +7,10 @@ small pool of worker threads. The request lifecycle:
    mesh, sign the raw DAG once (``base.plan_signature`` — the same
    traversal ``evaluate()`` would do), then enqueue. Admission past
    the high-water mark raises ``Backpressure(retry_after_s=...)``
-   instead of queueing unbounded latency.
+   instead of queueing unbounded latency; with an HBM budget known
+   (predictive memory governor, docs/MEMORY.md) a submission whose
+   predicted peak cannot fit next to the in-flight memory
+   reservations is rejected the same way.
 2. **batch** (worker): pop the head request, pull every queued request
    with the same plan signature, linger one batching window
    (``FLAGS.serve_batch_window_s``) for stragglers, and re-pull.
@@ -46,12 +49,14 @@ from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY, labeled
 from ..parallel import mesh as mesh_mod
 from ..resilience import engine as resilience_engine
+from ..resilience import memory as memory_mod
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from ..utils.log import log_warn
 from ..resilience import classify as resilience_classify
 from . import coalesce
-from .future import DeadlineExceeded, EvalFuture, MeshReconfiguring
+from .future import (Backpressure, DeadlineExceeded, EvalFuture,
+                     MeshReconfiguring)
 from .queue import AdmissionQueue
 
 FLAGS.define_int(
@@ -91,13 +96,57 @@ def _pow2_chunks(batch: List["_Request"]) -> List[List["_Request"]]:
     return out
 
 
+class _MemoryLedger:
+    """In-flight memory reservations (the admission tier of the
+    predictive memory governor, docs/MEMORY.md): each dispatch
+    reserves its predicted per-chip peak when a worker picks it up and
+    releases it at future resolution, so ``submit`` can reject
+    combinations of requests whose modeled working sets cannot fit in
+    HBM together — with a retryable ``Backpressure`` instead of a
+    device OOM that trips the whole engine. One leaf lock; never held
+    while dispatching."""
+
+    __slots__ = ("_lock", "_reserved")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._reserved = 0
+
+    def reserved(self) -> int:
+        return self._reserved
+
+    def reserve(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._lock:
+            self._reserved += n
+            now = self._reserved
+        if _METRICS_FLAG._value:
+            REGISTRY.gauge(
+                "serve_mem_reserved_bytes",
+                "predicted per-chip bytes reserved by in-flight serve "
+                "dispatches (high-water tracked)").set(float(now))
+
+    def release(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._lock:
+            self._reserved = max(0, self._reserved - n)
+            now = self._reserved
+        if _METRICS_FLAG._value:
+            REGISTRY.gauge(
+                "serve_mem_reserved_bytes",
+                "predicted per-chip bytes reserved by in-flight serve "
+                "dispatches (high-water tracked)").set(float(now))
+
+
 class _Request:
     """One queued evaluation. Signed at submit time (caller thread) so
     workers can group by plan signature without re-traversing."""
 
     __slots__ = ("expr", "donate", "tenant", "deadline", "future",
                  "plan_key", "leaves", "mesh", "coalescable",
-                 "t_submit", "taken")
+                 "t_submit", "taken", "mem_bytes")
 
     def __init__(self, expr: Any, donate: List[Any],
                  tenant: Optional[str], deadline_s: Optional[float],
@@ -112,6 +161,7 @@ class _Request:
         self.future.t_submit = self.t_submit
         self.mesh = mesh
         self.taken = False  # queue bookkeeping (AdmissionQueue)
+        self.mem_bytes = 0  # predicted peak (memory-aware admission)
         self.plan_key, sig_ctx = base.plan_signature(expr, mesh)
         self.leaves = sig_ctx.leaves
         # donating requests never coalesce: buffer aliasing is a
@@ -150,6 +200,8 @@ class ServeEngine:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
+        # in-flight memory reservations (predictive governor tier 3)
+        self.ledger = _MemoryLedger()
         # elastic recovery gate: while the mesh rebuilds, submissions
         # fail fast with MeshReconfiguring(retry_after_s=this value)
         # instead of queueing onto a dead mesh. None = admitting.
@@ -243,6 +295,25 @@ class ServeEngine:
         donated = base._norm_donate(donate)
         req = _Request(expr, donated, tenant, deadline_s,
                        mesh_mod.get_mesh())
+        # memory-aware admission (docs/MEMORY.md): when a budget is
+        # known, a submission whose predicted peak cannot fit next to
+        # the in-flight reservations is rejected with the SAME
+        # retryable Backpressure contract as queue-depth shedding —
+        # the client backs off instead of the whole engine OOMing.
+        budget = (memory_mod.hbm_budget_bytes()
+                  if memory_mod._GOVERNOR_FLAG._value else None)
+        if budget:
+            req.mem_bytes = memory_mod.request_bytes(
+                base.lookup_plan(req.plan_key), req.leaves, req.mesh)
+            if req.mem_bytes + self.ledger.reserved() > budget:
+                if _METRICS_FLAG._value:
+                    REGISTRY.counter(
+                        "serve_mem_rejected",
+                        "submissions shed because their predicted "
+                        "peak would overflow the HBM budget").inc()
+                raise Backpressure(
+                    self.queue.depth(),
+                    self.queue.retry_after_s(self.workers))
         if not self.running:
             self.start()
         self.queue.put(req, workers=self.workers)
@@ -254,6 +325,8 @@ class ServeEngine:
         coal = c.get("serve_coalesced_requests", 0)
         return {
             "queue_depth": self.queue.depth(),
+            "mem_reserved_bytes": self.ledger.reserved(),
+            "mem_rejected": c.get("serve_mem_rejected", 0),
             "requests": total,
             "coalesced_requests": coal,
             "coalesced_batches": c.get("serve_coalesced_batches", 0),
@@ -358,14 +431,31 @@ class ServeEngine:
         deadlines = [r.remaining_s() for r in batch]
         tightest = min((d for d in deadlines if d is not None),
                        default=None)
-        with mesh_mod.use_mesh(batch[0].mesh), \
-                numerics_mod.deadline_scope(tightest):
-            results = coalesce.dispatch_batch(plan, batch, batch[0].mesh)
+        # one reservation for the whole batch: each request brings its
+        # own predicted peak (the leading client axis scales working
+        # sets ~linearly; the batch program is not re-modeled —
+        # docs/MEMORY.md blind spots)
+        reserved = sum(r.mem_bytes for r in batch)
+        self.ledger.reserve(reserved)
+        try:
+            with mesh_mod.use_mesh(batch[0].mesh), \
+                    numerics_mod.deadline_scope(tightest):
+                results = coalesce.dispatch_batch(plan, batch,
+                                                  batch[0].mesh)
+        finally:
+            self.ledger.release(reserved)
         for r, res in zip(batch, results):
             r.future.coalesced = len(batch)
             r.future._resolve(res)
 
     def _solo(self, r: _Request) -> None:
+        self.ledger.reserve(r.mem_bytes)
+        try:
+            self._solo_inner(r)
+        finally:
+            self.ledger.release(r.mem_bytes)
+
+    def _solo_inner(self, r: _Request) -> None:
         with mesh_mod.use_mesh(r.mesh), \
                 resilience_engine.tenant_scope(r.tenant), \
                 numerics_mod.deadline_scope(r.remaining_s()):

@@ -198,6 +198,14 @@ FLAGS.define_float(
     "tiling_operand_move_weight", 0.0,
     "Weight on GEMM operand-reshard bytes vs output-psum bytes in the "
     "smart-tiling cost model (0 = built-in calibrated default).")
+FLAGS.define_float(
+    "tiling_memory_weight", 0.0,
+    "Soft memory term in the smart-tiling cost model: each candidate "
+    "tiling's cost gains weight x its per-chip OUTPUT bytes, so plans "
+    "near the HBM budget prefer finer (more parallel) tilings before "
+    "the memory governor has to force a full degradation rung. 0 = "
+    "off (pure speed). Part of the plan/compile cache keys. See "
+    "docs/MEMORY.md.")
 FLAGS.define_bool("opt_fold_slices", True,
                   "Fold slice-of-slice and slice-of-map expressions.")
 FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
@@ -240,6 +248,12 @@ FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force()."
 #   fault_inject / fault_seed (faults.py, defaults "" / 0) — seeded
 #       chaos spec ('transient@2,oom@4x3,slow@1=0.5,io@0'), installed
 #       by st.initialize() or st.chaos().
+#   hbm_budget_bytes / memory_governor (memory.py, defaults 0 / True)
+#       — predictive memory governor (docs/MEMORY.md): per-plan
+#       peak-HBM model, ladder rung chosen BEFORE the first dispatch
+#       when the prediction exceeds the budget, serve reservation
+#       ledger. 0 = auto-detect from device memory_stats (None on
+#       CPU: governor inert unless set explicitly).
 #   loop_restore_max     (loop_ckpt.py, default 3)   — checkpoint
 #       restores per checkpointed st.loop before the failure escapes.
 FLAGS.define_bool(

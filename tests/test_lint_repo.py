@@ -255,3 +255,30 @@ def test_mesh_capture_allowed_in_parallel():
                         "mesh.py")
     tree = ast.parse("from x import get_mesh\n_M = get_mesh()\n")
     assert lint_repo.lint_mesh_capture(path, tree) == []
+
+
+def test_catches_raw_memory_stats(tmp_path):
+    bad = tmp_path / "probe.py"
+    bad.write_text(
+        "import jax\n"
+        "s = jax.local_devices()[0].memory_stats()\n"
+        "def probe(dev):\n"
+        "    return dev.memory_stats() or {}\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_raw_memory_stats(str(bad), tree)
+    assert sum(f.rule == "raw-memory-stats" for f in findings) == 2
+    # ... and the sanctioned aggregate is named in the remedy
+    assert all("device_memory_aggregate" in f.message for f in findings)
+
+
+def test_raw_memory_stats_allowed_in_owners(tmp_path):
+    tree = ast.parse("import jax\n"
+                     "s = jax.local_devices()[0].memory_stats()\n")
+    for rel in (os.path.join("spartan_tpu", "obs", "metrics.py"),
+                os.path.join("spartan_tpu", "parallel", "mesh.py"),
+                os.path.join("spartan_tpu", "resilience", "memory.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_raw_memory_stats(path, tree) == []
+    # attribute reads that are not calls (docs, strings) are NOT flagged
+    other = ast.parse("name = 'memory_stats'\nx = obj.memory_stats\n")
+    assert lint_repo.lint_raw_memory_stats("/x/y.py", other) == []

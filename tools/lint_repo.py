@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Seven repo-specific rules that generic linters cannot know:
+Eight repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -72,6 +72,16 @@ Seven repo-specific rules that generic linters cannot know:
    mesh) are fine — they carry the birth EPOCH alongside, and
    cross-epoch use raises ``StaleMeshError``.
 
+8. No direct ``.memory_stats()`` calls outside ``obs/metrics.py``,
+   ``parallel/mesh.py`` and ``resilience/memory.py`` (the memory-
+   governor PR): the HBM-budget auto-detect and every exported memory
+   gauge must agree on ONE aggregated read-out across all local
+   devices — a stray per-device read reintroduces the
+   only-device-0 blind spot the governor PR fixed, and its numbers
+   silently disagree with ``FLAGS.hbm_budget_bytes`` auto-detection
+   and the ``device_*`` gauges. Go through
+   ``obs.metrics.device_memory_aggregate()``.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -127,6 +137,14 @@ _CACHE_NAMES = {"_plan_cache", "_compile_cache", "_cache_lock"}
 _CACHE_OWNER = os.path.join("spartan_tpu", "expr", "base.py")
 _REGISTRY_INTERNALS = {"_counters", "_gauges", "_hists"}
 _METRICS_OWNER = os.path.join("spartan_tpu", "obs", "metrics.py")
+
+# rule 8: the only modules allowed to read device memory_stats
+# directly — budget auto-detect and memory gauges stay single-sourced
+_MEMSTATS_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "obs", "metrics.py"),
+    os.path.join("spartan_tpu", "parallel", "mesh.py"),
+    os.path.join("spartan_tpu", "resilience", "memory.py"),
+}
 
 # rule 7: mesh constructors whose results must not live in module
 # globals / class attributes outside the owning package — a captured
@@ -437,6 +455,29 @@ def lint_mesh_capture(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_raw_memory_stats(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 8: no direct ``.memory_stats()`` calls outside the three
+    sanctioned modules — the budget auto-detect and the device gauges
+    must read ONE aggregated source across all local devices."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _MEMSTATS_ALLOWED_FILES:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "memory_stats"):
+            findings.append(Finding(
+                path, node.lineno, "raw-memory-stats",
+                "direct .memory_stats() call: device memory read-outs "
+                "are single-sourced (obs/metrics.py, parallel/mesh.py, "
+                "resilience/memory.py) so the HBM budget auto-detect "
+                "and the device_* gauges agree — use "
+                "obs.metrics.device_memory_aggregate() (all local "
+                "devices, max+sum), not a per-device probe"))
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -523,6 +564,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_bare_recovery(path, tree))
         findings.extend(lint_shared_state(path, tree))
         findings.extend(lint_mesh_capture(path, tree))
+        findings.extend(lint_raw_memory_stats(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
