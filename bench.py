@@ -118,7 +118,12 @@ def _arm_stage_forensics(stage: str) -> None:
       (grace period) before SIGKILL, so the child exports its partial
       Chrome trace, ``st.metrics()`` snapshot, in-flight span tree and
       last health word before dying: the K=1/K=512 hang class
-      (BENCH_r05.json) leaves forensics instead of nothing;
+      (BENCH_r05.json) leaves forensics instead of nothing. Since the
+      prediction-loop PR the dump also folds in the flight recorder's
+      per-request timelines (which serve requests were in flight, with
+      their latency decomposition) and the cost ledger's
+      predicted-vs-measured state (dump_crash does this for every
+      caller);
     * the numerics dispatch watchdog (``FLAGS.dispatch_timeout_s``,
       armed by the parent via SPARTAN_TPU_DISPATCH_TIMEOUT_S) — fires
       from INSIDE a hung dispatch with the in-flight tree, before the

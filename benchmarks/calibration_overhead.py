@@ -1,0 +1,131 @@
+"""Cost of the prediction-loop ledger + flight recorder (ISSUE 9 gate).
+
+Three arms on the steady-state k-means-step hit path, interleaved per
+iteration (medians):
+
+* ``base`` — ``FLAGS.cost_ledger`` off AND ``expr.base``'s
+  ``ledger_mod`` binding swapped for a null shim: what the dispatch
+  path looks like with no ledger compiled in at all.
+* ``off`` — the real module with ``FLAGS.cost_ledger=False`` (the
+  feature present but disabled: ONE flag read per dispatch).
+  ``calibration_off_overhead_ratio`` = off/base - 1 is the committed
+  <=0.01 gate (benchmarks/thresholds.json) — turning the prediction
+  loop off must be free.
+* ``on`` — ``FLAGS.cost_ledger=True`` (recording: a dict update under
+  the ledger lock per dispatch). ``calibration_on_overhead_ratio`` is
+  REPORTED, NOT GATED — it is the production default's price and
+  should stay near zero, but it is a measurement, not a contract.
+
+The flight recorder costs nothing here by construction (it hooks the
+serve path only; plain evaluate() never touches it) — the serve-side
+toll is covered by ``serve_off_overhead_ratio``. The ledger snapshot
+for the measured plan rides along as evidence the on arm recorded.
+
+Prints ONE JSON line.
+
+Usage: python benchmarks/calibration_overhead.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _NullLedger:
+    """What expr/base.py's dispatch + miss paths look like with no
+    ledger compiled in: the flag reads False, the hooks vanish."""
+
+    class _Flag:
+        _value = False
+
+    _LEDGER_FLAG = _Flag()
+
+    @staticmethod
+    def note_plan(plan):
+        return None
+
+    @staticmethod
+    def note_dispatch(digest, kind, seconds):
+        return None
+
+
+def measure(iters: int = 100, n: int = 4096, d: int = 32,
+            k: int = 16) -> dict:
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr import base as expr_base
+    from spartan_tpu.expr.base import ValExpr
+    from spartan_tpu.obs import ledger
+    from spartan_tpu.obs.explain import key_hash
+    from spartan_tpu.utils import profiling
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+
+    real_ledger = expr_base.ledger_mod
+    saved_flag = FLAGS.cost_ledger
+
+    def step(cur):
+        return kmeans_step(pts, ValExpr(cur), k).evaluate()
+
+    c = step(step(c))  # warm the plan so every iteration is a hit
+    plan_digest = key_hash(expr_base.plan_signature(
+        kmeans_step(pts, ValExpr(c), k))[0])
+
+    times = {"base": [], "off": [], "on": []}
+    try:
+        for _ in range(iters):
+            for arm in ("base", "off", "on"):
+                expr_base.ledger_mod = (_NullLedger if arm == "base"
+                                        else real_ledger)
+                FLAGS.cost_ledger = arm == "on"
+                with profiling.stopwatch() as sw:
+                    c = step(c)
+                    c.glom()  # fetch-forced: dispatch really finished
+                times[arm].append(sw.elapsed)
+    finally:
+        expr_base.ledger_mod = real_ledger
+        FLAGS.cost_ledger = saved_flag
+
+    t_base = float(np.median(times["base"]))
+    t_off = float(np.median(times["off"]))
+    t_on = float(np.median(times["on"]))
+
+    # evidence the on arm recorded: the measured plan's ledger entry
+    entry = ledger.snapshot()["plans"].get(plan_digest) or {}
+    measured = entry.get("measured") or {}
+    return {
+        "metric": "calibration_overhead",
+        "iters": iters,
+        "shape": [n, d, k],
+        "wall_us_per_iter_base": round(t_base * 1e6, 1),
+        "wall_us_per_iter_ledger_off": round(t_off * 1e6, 1),
+        "wall_us_per_iter_ledger_on": round(t_on * 1e6, 1),
+        "calibration_off_overhead_ratio": round(
+            max(0.0, t_off / t_base - 1.0), 4),
+        "calibration_on_overhead_ratio": round(
+            max(0.0, t_on / t_base - 1.0), 4),
+        "ledger_dispatches_recorded": measured.get("dispatch_count", 0),
+        "ledger_dp_cost": (entry.get("predicted") or {}).get("dp_cost"),
+    }
+
+
+def main() -> None:
+    iters = 100
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    out = measure(iters=iters, n=512 if small else 4096)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

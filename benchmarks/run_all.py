@@ -250,6 +250,19 @@ def memgov_overhead(st):
     return mg.measure(iters=60, n=512 if SMALL else 4096)
 
 
+def calibration_overhead(st):
+    """Prediction-loop gates (benchmarks/calibration_overhead.py):
+    the cost ledger's hit-path toll with the feature DISABLED (<=1%
+    is the ISSUE-9 gate: one flag read per dispatch) plus the
+    ledger-on recording cost, reported unjudged (the production
+    default's price: a dict update under the ledger lock per
+    dispatch)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import calibration_overhead as co
+
+    return co.measure(iters=60, n=512 if SMALL else 4096)
+
+
 def serving_overhead(st):
     """Serving-engine gates (benchmarks/serving_latency.py): 16-client
     coalesced throughput vs a serial evaluate() loop (>=3x is the
@@ -336,6 +349,9 @@ def guard_metrics(report) -> dict:
         "memgov_off_overhead_ratio":
             report["memgov_overhead"].get(
                 "memgov_off_overhead_ratio"),
+        "calibration_off_overhead_ratio":
+            report["calibration_overhead"].get(
+                "calibration_off_overhead_ratio"),
     }
 
 
@@ -363,6 +379,7 @@ def main():
         "serving_overhead": _with_metrics(serving_overhead, st),
         "elastic_overhead": _with_metrics(elastic_overhead, st),
         "memgov_overhead": _with_metrics(memgov_overhead, st),
+        "calibration_overhead": _with_metrics(calibration_overhead, st),
     }
     # full flag state once at report level (the per-record
     # flags_nondefault deltas are diffs against these defaults)
@@ -387,13 +404,18 @@ def main():
         # the measurement): verify <10% of a cold evaluate, tracing
         # <=5% of a steady-state evaluate, numerics sentinel (audit
         # off) <=1% of a steady-state evaluate
+        # serve_off carries 2% (not 1%): re-committed by the ISSUE-9
+        # de-flake — the ratio measures a ~0 true difference and its
+        # median-of-k interleaved estimate still wobbles ~1% on the
+        # 1-core CPU box (see thresholds.json note_serving)
         fixed = {"verify_check_vs_cold_ratio": 0.1,
                  "obs_overhead_ratio": 0.05,
                  "numerics_off_overhead_ratio": 0.01,
                  "resilience_off_overhead_ratio": 0.01,
-                 "serve_off_overhead_ratio": 0.01,
+                 "serve_off_overhead_ratio": 0.02,
                  "elastic_off_overhead_ratio": 0.01,
-                 "memgov_off_overhead_ratio": 0.01}
+                 "memgov_off_overhead_ratio": 0.01,
+                 "calibration_off_overhead_ratio": 0.01}
         # fixed FLOORS (ISSUE gates on ratios that must stay high):
         # coalescing must amortize dispatch >=3x across 16 clients
         fixed_min = {"serve_coalesced_speedup": 3.0}

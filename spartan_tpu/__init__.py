@@ -32,9 +32,10 @@ from .parallel.mesh import (StaleMeshError, build_mesh, get_mesh,
 from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import check, lint
 from . import obs
-from .obs import (AuditReport, ExplainReport, Watchpoint, audit, explain,
-                  loop_health, metrics, trace_clear, trace_events,
-                  trace_export, unwatch, watch)
+from .obs import (AuditReport, CalibrationProfile, ExplainReport,
+                  Watchpoint, audit, explain, fit_profile, load_profile,
+                  loop_health, metrics, save_profile, trace_clear,
+                  trace_events, trace_export, unwatch, watch)
 from . import resilience
 from .resilience import ChaosPlan, FatalMeshError, chaos, chaos_clear
 from . import serve
@@ -54,6 +55,8 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "check", "lint",
             "obs", "explain", "ExplainReport", "metrics", "trace_export",
             "trace_events", "trace_clear",
+            "ledger", "flightrec", "CalibrationProfile", "fit_profile",
+            "save_profile", "load_profile",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
             "loop_health",
             "resilience", "chaos", "chaos_clear", "ChaosPlan",
@@ -86,6 +89,24 @@ def initialize(argv=None):
     _mesh.initialize_distributed()  # no-op unless COORDINATOR/SLURM env
     _mesh.get_mesh()
     return rest
+
+
+def ledger(validate=False):
+    """The device-time cost ledger (docs/OBSERVABILITY.md): per-plan
+    predicted-vs-measured ratios for the tiling-DP cost, peak-HBM and
+    service-time models, per-model aggregates + drift counts, and the
+    active calibration state. ``validate=True`` first runs the XLA
+    memory validation for live plans missing actuals (one AOT compile
+    each)."""
+    return obs.ledger_snapshot(validate=validate)
+
+
+def flightrec(limit=None):
+    """The per-request flight recorder (docs/OBSERVABILITY.md): recent
+    lifecycle events (newest ``limit`` when given), reconstructed
+    per-request timelines, and per-tenant latency-decomposition
+    histograms for the serve path."""
+    return obs.flightrec(limit=limit)
 
 
 def shutdown():

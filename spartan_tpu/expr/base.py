@@ -29,6 +29,7 @@ from ..array import distarray as da
 from ..array import tiling as tiling_mod
 from ..array.distarray import DistArray
 from ..array.tiling import Tiling
+from ..obs import ledger as ledger_mod
 from ..obs import numerics as numerics_mod
 from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
@@ -707,8 +708,11 @@ class _Plan:
     once on the miss path and shared between the cached plan and its
     first-run identity variant."""
 
+    # __weakref__: the cost ledger (obs/ledger.py) keeps weak plan
+    # references so st.ledger(validate=True) can run the memory
+    # validation for live plans without pinning evicted ones
     __slots__ = ("key", "traced", "out_tilings", "is_tuple", "arg_order",
-                 "report", "governed_rung")
+                 "report", "governed_rung", "__weakref__")
 
     def __init__(self, key: Tuple, traced: Callable,
                  out_tilings: Tuple[Tiling, ...], is_tuple: bool,
@@ -1045,12 +1049,20 @@ def _opt_flags_key() -> Tuple:
         # share a key; likewise the OOM degradation rung
         # (resilience/degrade.py) forces different tilings/passes, so
         # degraded and normal plans are keyed apart
+        # cost calibration re-weights the tiling DP's terms
+        # (obs/ledger profile -> tiling_cost._cal_factors), so a
+        # calibrated plan must never alias an uncalibrated one: the
+        # active profile's fingerprint is part of the key (set_profile
+        # writes the fingerprint FLAG, which bumps mutation_count and
+        # invalidates this memo)
+        cal = ((FLAGS.cost_calibration_fingerprint or "on")
+               if FLAGS.cost_calibration else None)
         key = (tuple(p.name for p in _PASSES if p.enabled()),
                FLAGS.opt_fold_slices, FLAGS.placement,
                FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
                FLAGS.tiling_operand_move_weight,
                FLAGS.tiling_memory_weight,
-               bool(FLAGS.audit_numerics))
+               bool(FLAGS.audit_numerics), cal)
         _opt_key_memo = (ver, key)
     return key + (getattr(degrade_mod._TLS, "rung", None),)
 
@@ -1196,7 +1208,9 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
             if FLAGS.profile:
-                with jax.profiler.trace(FLAGS.profile_dir):
+                # device-profile capture via the ONE sanctioned
+                # jax.profiler entry point (obs/trace, lint rule 9)
+                with prof.device_profile(FLAGS.profile_dir):
                     with launch_guard():
                         o = ex.jitted(*args)
                     jax.block_until_ready(o)
@@ -1206,7 +1220,8 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
 
     fresh = not ex.warm
     phase_name = "compile" if fresh else "dispatch"
-    with prof.phase(phase_name) as dsp:
+    phase_ctx = prof.phase(phase_name)
+    with phase_ctx as dsp:
         # dispatch watchdog (obs/numerics.py): a run that exceeds
         # FLAGS.dispatch_timeout_s dumps the in-flight span tree +
         # plan report + last health word to a crash file; a shared
@@ -1223,6 +1238,11 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         if dpos:
             dsp.set(donated=sorted(dpos))
     ex.warm = True
+    if ledger_mod._LEDGER_FLAG._value and plan.report is not None:
+        # cost ledger: the measured wall time of this run, next to the
+        # plan's predicted tiling-DP cost (one flag read when off)
+        ledger_mod.note_dispatch(plan.report.get("plan_key"),
+                                 phase_name, phase_ctx.seconds)
 
     if FLAGS.check_determinism and not dpos:  # a donated arg is gone
         out2 = run()
@@ -1443,13 +1463,20 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
                                                       mesh)
     plan = _Plan(key, traced, out_tilings, is_tuple, identity, report)
 
+    ledger_plan = plan
     if rctx is not None and plan_key is not None:
         if raw_order is not None:
             stored = _Plan(key, traced, out_tilings, is_tuple, raw_order,
                            report)
-            store_plan(plan_key, stored)
+            # the winner of a store race is what later lookups (and
+            # st.ledger's validation) see — ledger the same object
+            ledger_plan = store_plan(plan_key, stored)
         else:
             prof.count("plan_uncacheable")
+    # cost ledger (obs/ledger.py): record this plan's predictions
+    # (DP cost + per-class components, modeled peak HBM) so measured
+    # dispatch times land next to them. Miss-path only.
+    ledger_mod.note_plan(ledger_plan)
     return plan, dag, leaves
 
 

@@ -78,6 +78,36 @@ def _mesh_n(mesh) -> int:
     return mesh_mod.device_count(mesh)
 
 
+def op_class(node: Expr) -> str:
+    """The node's cost-model op class — the vocabulary the calibration
+    profile's per-class factors are keyed by (obs/ledger.CLASSES):
+    contraction nodes are FLOP-priced, everything else is priced by
+    output bytes under its class factor; 'reshard' and 'psum' are edge
+    classes, not node classes."""
+    if _contraction_view(node) is not None:
+        return "contraction"
+    if isinstance(node, MapExpr):
+        return "map"
+    if isinstance(node, (ReduceExpr, GeneralReduceExpr)):
+        return "reduce"
+    if isinstance(node, TransposeExpr):
+        return "transpose"
+    if isinstance(node, SliceExpr):
+        return "slice"
+    return "other"
+
+
+def _cal_factors() -> Optional[Dict[str, float]]:
+    """The active calibration profile's per-op-class factors, or None
+    when ``FLAGS.cost_calibration`` is off / no profile is installed
+    (obs/ledger owns the profile; one read per table build). The
+    factor fingerprint is part of ``_opt_flags_key``, so calibrated
+    and uncalibrated plans never alias."""
+    from ..obs import ledger
+
+    return ledger.factors()
+
+
 def _parallelism(t: Tiling, mesh) -> int:
     p = 1
     for n in t.tiles_per_dim(mesh):
@@ -286,6 +316,14 @@ def _build_table(root: Expr, mesh) -> Dict:
     flop_w = _flop_weight()
     move_w = _operand_move_weight()
     mem_w = _memory_weight()
+    # profile-guided calibration (obs/ledger): per-op-class factors
+    # multiply the matching cost terms; identity when no profile is
+    # active. Applied symmetrically to selection (best_child's move
+    # weight) and pricing so the DP stays self-consistent.
+    cal = _cal_factors()
+    reshard_f = cal.get("reshard", 1.0) if cal else 1.0
+    psum_f = cal.get("psum", 1.0) if cal else 1.0
+    flop_f = cal.get("contraction", 1.0) if cal else 1.0
 
     def nbytes(e: Expr) -> float:
         return float(e.size) * e.dtype.itemsize
@@ -324,13 +362,14 @@ def _build_table(root: Expr, mesh) -> Dict:
             return
         kids = node.children()
         cview = _contraction_view(node)
+        node_f = cal.get(op_class(node), 1.0) if cal else 1.0
         for t in candidates(node, mesh):
             # soft memory term: per-chip output residency of this
             # candidate, charged on contraction and non-contraction
             # nodes alike (0 when the weight flag is off)
             memcost = (mem_w * nbytes(node) / _parallelism(t, mesh)
                        if mem_w else 0.0)
-            compute = (nbytes(node) * weight
+            compute = (nbytes(node) * weight * node_f
                        / _parallelism(t, mesh))
             if cview is not None:
                 # search contraction strategies: s=None gathers the
@@ -345,8 +384,10 @@ def _build_table(root: Expr, mesh) -> Dict:
                               if has_contraction else [None])
                 for s in strategies:
                     req_a, req_b = reqs_fn(t, s)
-                    ca, pa, ma = best_child(kids[0], req_a, move_w)
-                    cb, pb, mb = best_child(kids[1], req_b, move_w)
+                    ca, pa, ma = best_child(kids[0], req_a,
+                                            move_w * reshard_f)
+                    cb, pb, mb = best_child(kids[1], req_b,
+                                            move_w * reshard_f)
                     psum = 0.0
                     if s is not None:
                         # ring all-reduce of each chip's PARTIAL — the
@@ -354,10 +395,10 @@ def _build_table(root: Expr, mesh) -> Dict:
                         # array: reduce-scatter + all-gather moves
                         # ~2 x shard x (ns-1)/ns per chip
                         ns = _axis_size(mesh, s)
-                        psum = (2.0 * nbytes(node)
+                        psum = (2.0 * nbytes(node) * psum_f
                                 / _parallelism(t, mesh)
                                 * (ns - 1) / ns)
-                    fl = (flops * flop_w
+                    fl = (flops * flop_w * flop_f
                           / (_parallelism(t, mesh) * _axis_size(mesh, s)))
                     # operand movement is charged at move_w inside
                     # best_child (critical path before the matmul —
@@ -373,7 +414,7 @@ def _build_table(root: Expr, mesh) -> Dict:
             picks: List[Tiling] = []
             for i, c in enumerate(kids):
                 req = _operand_requirement(node, t, c, i)
-                ccost, pick, _ = best_child(c, req)
+                ccost, pick, _ = best_child(c, req, reshard_f)
                 comm += ccost
                 picks.append(pick)
             entries[t] = (comm + compute + memcost, tuple(picks), None)
@@ -466,6 +507,84 @@ def gemm_plan_costs(root: Expr) -> Dict:
                 ((t, e[2], e[0]) for t, e in table[n._id].items()),
                 key=lambda x: x[2])
     return out
+
+
+def class_components(root: Expr, mesh=None) -> Dict[str, float]:
+    """Per-op-class decomposition of the CHOSEN plan's modeled cost.
+
+    Re-prices the optimized DAG at its committed tilings
+    (``out_tiling()``, post-assignment) with the same formulas as
+    ``_build_table`` — node compute under its class, contraction FLOPs
+    under 'contraction', operand moves under 'reshard', output
+    all-reduces under 'psum' — WITHOUT the candidate search. This is
+    the vector the cost ledger records per plan and ``fit_profile``
+    regresses measured dispatch time against: the classes are exactly
+    the terms a calibration factor can scale, so a fitted profile's
+    corrections mean the same thing here and in the DP. Uncalibrated
+    by construction (factors of 1): a profile fitted FROM these
+    components corrects the base model, not itself. Empty on a
+    single-device mesh (no DP ran)."""
+    from .base import ScalarExpr, ValExpr
+    from .optimize import dag_nodes
+
+    mesh = mesh or mesh_mod.get_mesh()
+    if _mesh_n(mesh) <= 1:
+        return {}
+    weight = _compute_weight()
+    flop_w = _flop_weight()
+    move_w = _operand_move_weight()
+    comp: Dict[str, float] = {}
+
+    def add(cls: str, v: float) -> None:
+        if v:
+            comp[cls] = comp.get(cls, 0.0) + float(v)
+
+    def move(child: Expr, req: Optional[Tiling], w: float) -> float:
+        if req is None:
+            return 0.0
+        try:
+            src = child.out_tiling()
+        except Exception:
+            return 0.0
+        nb = float(child.size) * child.dtype.itemsize
+        return w * reshard_cost(src, req, nb, mesh)
+
+    for n in dag_nodes(root):
+        if isinstance(n, (ValExpr, ScalarExpr)):
+            continue
+        try:
+            t = n.out_tiling()
+        except Exception:
+            continue
+        nbytes = float(n.size) * n.dtype.itemsize
+        kids = n.children()
+        cview = _contraction_view(n)
+        if cview is not None and len(kids) >= 2:
+            flops, reqs_fn, _has = cview
+            plan = getattr(n, "_dot_plan", None)
+            grid, s = plan if plan is not None else (t, None)
+            par = _parallelism(grid, mesh)
+            add("contraction", flops * flop_w
+                / (par * _axis_size(mesh, s)))
+            if s is not None:
+                ns = _axis_size(mesh, s)
+                add("psum", 2.0 * nbytes / par * (ns - 1) / ns)
+            try:
+                reqs = reqs_fn(grid, s)
+            except Exception:
+                reqs = None
+            if reqs is not None:
+                for c, req in zip(kids, reqs):
+                    add("reshard", move(c, req, move_w))
+            continue
+        add(op_class(n), nbytes * weight / _parallelism(t, mesh))
+        for i, c in enumerate(kids):
+            try:
+                req = _operand_requirement(n, t, c, i)
+            except Exception:
+                req = None
+            add("reshard", move(c, req, 1.0))
+    return {k: round(v, 3) for k, v in comp.items()}
 
 
 def calibrate_flop_weight(n: int = 512, iters: int = 5,

@@ -34,16 +34,26 @@ without import cycles; ``explain`` and ``numerics`` reach into the
 expr layer lazily.
 """
 
+from . import flight
+from . import ledger as _ledger_mod
 from . import metrics as _metrics_mod
 from . import numerics
 from . import trace as _trace_mod
 from .explain import ExplainReport, explain
+from .ledger import (CalibrationProfile, fit_profile, load_profile,
+                     save_profile)
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
 from .numerics import (AuditReport, Watchpoint, audit, dump_crash,
                        loop_health, unwatch, watch, watchpoints)
 from .trace import Span, span
 
+# keep the module importable as obs.ledger while exposing the snapshot
+# functions under distinct names (spartan_tpu/__init__ wraps them as
+# st.ledger() / st.flightrec())
+ledger = _ledger_mod
 metrics = _metrics_mod.snapshot
+ledger_snapshot = _ledger_mod.snapshot
+flightrec = flight.snapshot
 trace_export = _trace_mod.export
 trace_events = _trace_mod.events
 trace_clear = _trace_mod.clear
@@ -52,4 +62,7 @@ __all__ = ["span", "Span", "trace_export", "trace_events", "trace_clear",
            "metrics", "REGISTRY", "Registry", "Counter", "Gauge",
            "Histogram", "explain", "ExplainReport", "numerics",
            "audit", "AuditReport", "watch", "unwatch", "watchpoints",
-           "Watchpoint", "loop_health", "dump_crash"]
+           "Watchpoint", "loop_health", "dump_crash",
+           "ledger", "ledger_snapshot", "flight", "flightrec",
+           "CalibrationProfile", "fit_profile", "save_profile",
+           "load_profile"]

@@ -185,10 +185,30 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
     variant, so a cache-hit ``st.explain`` is instant)."""
     from ..parallel import mesh as mesh_mod
 
+    # the tiling DP's prediction for this plan: the roots' cumulative
+    # chosen-tiling cost (bytes-equivalent) and its per-op-class
+    # decomposition — what the cost ledger compares against measured
+    # dispatch time and what fit_profile calibrates from
+    dp_cost: Optional[float] = None
+    components: Optional[Dict[str, float]] = None
+    try:
+        from ..expr import tiling_cost
+        from ..expr.base import TupleExpr
+
+        roots = dag.elements if isinstance(dag, TupleExpr) else (dag,)
+        vals = [getattr(r, "_plan_cost", None) for r in roots]
+        vals = [float(v) for v in vals if v is not None]
+        dp_cost = sum(vals) if vals else None
+        components = tiling_cost.class_components(dag) or None
+    except Exception:  # noqa: BLE001 - the prediction is advisory
+        pass
+
     report: Dict[str, Any] = {
         "root": _label(expr),
         "site": _site_str(expr._site),
         "plan_key": key_hash(plan_key),
+        "dp_cost": dp_cost,
+        "cost_components": components,
         # the mesh generation this plan was built for: after an
         # elastic rebuild (device loss), post-recovery explains show
         # which epoch — and therefore which device set — a plan binds
@@ -209,6 +229,17 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
     return report
 
 
+def compiled_cost_analysis(compiled: Any) -> Dict[str, float]:
+    """Normalize a jax ``Compiled.cost_analysis()`` read-out — the ONE
+    sanctioned call site (lint rule 9): every FLOPs/bytes estimate in
+    the package flows through here so it can land in the cost ledger
+    next to the model's prediction."""
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, list):
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis or {})
+
+
 def _compute_cost_analysis(plan: Any) -> Dict[str, float]:
     """AOT-lower + compile the plan's traced function over abstract
     arg specs and read XLA's FLOPs/bytes estimate. Memoized on the
@@ -217,10 +248,7 @@ def _compute_cost_analysis(plan: Any) -> Dict[str, float]:
 
     specs = plan.report.get("arg_specs") or []
     compiled = jax.jit(plan.traced).lower(*specs).compile()
-    analysis = compiled.cost_analysis()
-    if isinstance(analysis, list):
-        analysis = analysis[0] if analysis else {}
-    return dict(analysis or {})
+    return compiled_cost_analysis(compiled)
 
 
 class ExplainReport:
@@ -400,6 +428,12 @@ def explain(expr: Any, cost: bool = True) -> ExplainReport:
             })
     if cost and plan.report.get("cost_analysis") is None:
         plan.report["cost_analysis"] = _compute_cost_analysis(plan)
+        # the measured FLOPs land in the cost ledger next to the
+        # tiling DP's prediction for the same plan digest
+        from . import ledger as ledger_mod
+
+        ledger_mod.note_cost_analysis(plan.report.get("plan_key"),
+                                      plan.report["cost_analysis"])
     data = dict(plan.report)
     data["cache"] = status
     return ExplainReport(data)

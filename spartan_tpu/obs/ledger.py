@@ -1,0 +1,561 @@
+"""Device-time cost ledger + profile-guided cost-model calibration.
+
+The repo runs on three *predictive* models — the tiling DP's plan cost
+(``expr/tiling_cost``), the memory governor's peak-HBM live-set model
+(``resilience/memory``), and the serve queue's EMA service time
+(``serve/queue``) — and before this module nothing systematically
+compared their predictions to what the hardware actually did, so the
+DP's cost constants were unfalsifiable (TileLoom's lesson: cost-model
+planning only pays off when the model is validated against measured
+schedules). This module closes the loop:
+
+1. **The ledger** — one entry per plan-key digest recording the
+   predictions (tiling-DP cost + its per-op-class decomposition,
+   modeled peak HBM, queue-EMA service time) NEXT TO the measurements
+   (dispatch wall time from ``expr/base._dispatch``'s phase timer,
+   ``compiled.cost_analysis()`` FLOPs via ``st.explain``, XLA
+   ``memory_analysis()`` actuals via ``resilience.memory.validate_plan``,
+   per-request service wall time from the serve workers). ``st.ledger()``
+   snapshots it as JSON with per-plan measured-vs-predicted ratios and
+   per-model aggregates, updates the Prometheus
+   ``calibration_error_ratio{model=...}`` gauges, and — with
+   ``validate=True`` — runs the memory validation for live plans that
+   have no actuals yet. A measurement that lands more than
+   ``FLAGS.calibration_drift_tol`` away from its prediction (in
+   ``|log(pred/actual)|``) bumps the
+   ``calibration_drift_total{model=...}`` counter: alerting-grade
+   evidence that a cost constant has rotted on this platform.
+
+2. **Profile-guided calibration** — :func:`fit_profile` least-squares
+   per-op-class correction factors (map / reduce / transpose / slice /
+   other / contraction / reshard / psum — the exact term classes of the
+   tiling DP) from the ledger's component decompositions and measured
+   dispatch times. The resulting :class:`CalibrationProfile` persists
+   via ``st.save_profile(path)`` / ``st.load_profile(path)`` and, under
+   ``FLAGS.cost_calibration``, multiplies into the DP's edge/node costs
+   (``expr/tiling_cost._build_table``). The active profile's
+   fingerprint rides ``FLAGS.cost_calibration_fingerprint`` into
+   ``expr/base._opt_flags_key``, so calibrated and uncalibrated plans
+   never alias in the plan/compile caches.
+
+Units note: the DP cost is bytes-equivalent, not seconds, so its
+ledger ratio is scale-normalized — the per-platform seconds-per-unit
+scale is the median of measured/predicted over the entries, and the
+per-plan ratio is read against that scale. Calibration factors are
+likewise RELATIVE (cost-weighted mean 1 over the fit set): they reshape
+the model's trade-offs, never its absolute scale.
+
+Imports only the config + metrics layers (resilience/expr load lazily
+inside functions) — recordable from any subsystem without cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.config import FLAGS
+from .metrics import METRICS_FLAG as _METRICS_FLAG
+from .metrics import REGISTRY, labeled
+
+# define() returns the Flag; the dispatch hot path reads ._value
+# directly (expr/base._dispatch pays one attribute load when off).
+_LEDGER_FLAG = FLAGS.define_bool(
+    "cost_ledger", True,
+    "Record predicted-vs-measured cost per plan (tiling-DP cost, peak "
+    "HBM, service time vs dispatch wall time, cost_analysis FLOPs, "
+    "memory_analysis actuals) into the ledger behind st.ledger(). "
+    "Off-path cost when disabled is one flag read per dispatch "
+    "(benchmarks/calibration_overhead.py gate).")
+FLAGS.define_int(
+    "cost_ledger_max", 256,
+    "Maximum plan entries retained in the cost ledger; beyond it the "
+    "oldest entry is dropped (FIFO).")
+FLAGS.define_float(
+    "calibration_drift_tol", 0.693,
+    "Drift tolerance on |log(predicted/actual)| per cost model; a "
+    "measurement outside it bumps calibration_drift_total{model=...}. "
+    "Default log(2): predictions off by more than 2x either way "
+    "count as drift.")
+_CAL_FLAG = FLAGS.define_bool(
+    "cost_calibration", False,
+    "Multiply the active calibration profile's per-op-class factors "
+    "into the tiling DP's edge/node costs (st.load_profile installs "
+    "a profile). The profile fingerprint is part of the plan/compile "
+    "cache keys: calibrated and uncalibrated plans never alias.")
+FLAGS.define_str(
+    "cost_calibration_fingerprint", "",
+    "Fingerprint of the active calibration profile — set "
+    "AUTOMATICALLY by st.load_profile / ledger.set_profile (the flag "
+    "write invalidates the memoized plan-key flags component, so a "
+    "new profile re-keys every plan). Do not set by hand.")
+
+_MODELS = ("tiling_dp", "peak_hbm", "service_time")
+
+# the op-class vocabulary shared with expr/tiling_cost: node-class
+# factors scale the compute term of that node class; "contraction"
+# scales the FLOP term, "reshard" the operand-move bytes, "psum" the
+# output all-reduce bytes
+CLASSES = ("map", "reduce", "transpose", "slice", "other",
+           "contraction", "reshard", "psum")
+
+
+class _Entry:
+    """One plan-key digest's predictions and measurements."""
+
+    __slots__ = ("digest", "root", "dp_cost", "components",
+                 "pred_peak_bytes", "plan_ref", "flops",
+                 "xla_bytes_accessed", "pred_mem_bytes_validated",
+                 "xla_peak_bytes", "dispatch_count", "dispatch_total_s",
+                 "dispatch_min_s", "compile_s", "service_count",
+                 "service_total_s", "pred_service_total_s")
+
+    def __init__(self, digest: str):
+        self.digest = digest
+        self.root: Optional[str] = None
+        self.dp_cost: Optional[float] = None
+        self.components: Optional[Dict[str, float]] = None
+        self.pred_peak_bytes: Optional[int] = None
+        self.plan_ref: Optional[Any] = None
+        self.flops: Optional[float] = None
+        self.xla_bytes_accessed: Optional[float] = None
+        self.pred_mem_bytes_validated: Optional[int] = None
+        self.xla_peak_bytes: Optional[int] = None
+        self.dispatch_count = 0
+        self.dispatch_total_s = 0.0
+        self.dispatch_min_s: Optional[float] = None
+        self.compile_s: Optional[float] = None
+        self.service_count = 0
+        self.service_total_s = 0.0
+        self.pred_service_total_s = 0.0
+
+
+_lock = threading.Lock()
+_entries: "OrderedDict[str, _Entry]" = OrderedDict()
+# running log-scale EMA of measured-seconds / dp-cost (the tiling-DP
+# drift reference; n counts samples so drift only fires warmed up)
+_dp_state: Dict[str, float] = {"n": 0, "log_scale": 0.0}
+
+
+def _get_or_create(digest: str) -> _Entry:
+    """Entry lookup under ``_lock`` (caller holds it)."""
+    e = _entries.get(digest)
+    if e is None:
+        e = _entries[digest] = _Entry(digest)
+        maxn = max(8, int(FLAGS.cost_ledger_max))
+        while len(_entries) > maxn:
+            _entries.popitem(last=False)
+    return e
+
+
+def _drift(model: str, ratio: float) -> None:
+    """Count a prediction landing outside the drift tolerance."""
+    if ratio <= 0:
+        return
+    if abs(math.log(ratio)) > FLAGS.calibration_drift_tol:
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                labeled("calibration_drift_total", model=model),
+                "measurements whose |log(pred/actual)| exceeded "
+                "FLAGS.calibration_drift_tol, per cost model").inc()
+
+
+# -- recording hooks ------------------------------------------------------
+
+
+def note_plan(plan: Any) -> None:
+    """``expr/base._build_plan``'s hook: record the plan's predictions
+    (DP cost + components, modeled peak HBM) and keep a weakref for
+    on-demand validation. Miss-path only."""
+    if not _LEDGER_FLAG._value:
+        return
+    report = getattr(plan, "report", None)
+    if not report:
+        return
+    digest = report.get("plan_key")
+    if digest is None:
+        return
+    mem = report.get("memory") or {}
+    with _lock:
+        e = _get_or_create(digest)
+        e.root = report.get("root")
+        e.dp_cost = report.get("dp_cost")
+        e.components = report.get("cost_components")
+        e.pred_peak_bytes = mem.get("peak_bytes_per_chip")
+        try:
+            e.plan_ref = weakref.ref(plan)
+        except TypeError:
+            e.plan_ref = None
+
+
+def note_dispatch(digest: Optional[str], kind: str,
+                  seconds: float) -> None:
+    """``expr/base._dispatch``'s hook: one measured run of the plan's
+    executable. ``kind`` is the phase name ('dispatch' for warm runs,
+    'compile' for the first trace+compile call — kept separate so the
+    DP ratio never mixes compile time into dispatch time)."""
+    if not _LEDGER_FLAG._value or digest is None or seconds <= 0:
+        return
+    dp = None
+    with _lock:
+        e = _get_or_create(digest)
+        if kind == "compile":
+            e.compile_s = seconds
+            return
+        e.dispatch_count += 1
+        e.dispatch_total_s += seconds
+        if e.dispatch_min_s is None or seconds < e.dispatch_min_s:
+            e.dispatch_min_s = seconds
+        dp = e.dp_cost
+        if dp and dp > 0:
+            ls = math.log(seconds / dp)
+            if _dp_state["n"] == 0:
+                _dp_state["log_scale"] = ls
+            else:
+                _dp_state["log_scale"] += 0.1 * (ls
+                                                 - _dp_state["log_scale"])
+            _dp_state["n"] += 1
+            warmed = _dp_state["n"] >= 8
+            dev = abs(ls - _dp_state["log_scale"])
+    if dp and dp > 0 and warmed:
+        _drift("tiling_dp", math.exp(dev))
+
+
+def note_service(digest: Optional[str], predicted_s: float,
+                 measured_s: float) -> None:
+    """Serve-worker hook: the queue's EMA prediction at pop time vs
+    the request's measured service wall time."""
+    if not _LEDGER_FLAG._value or digest is None or measured_s <= 0:
+        return
+    with _lock:
+        e = _get_or_create(digest)
+        e.service_count += 1
+        e.service_total_s += measured_s
+        e.pred_service_total_s += max(0.0, predicted_s)
+    if predicted_s and predicted_s > 0:
+        _drift("service_time", predicted_s / measured_s)
+
+
+def note_memory_actual(digest: Optional[str], predicted: Any,
+                       actual: Any) -> None:
+    """``resilience.memory.validate_plan``'s hook: the alias-adjusted
+    predicted peak next to XLA's ``memory_analysis()`` actual."""
+    if digest is None or not actual:
+        return
+    with _lock:
+        e = _get_or_create(digest)
+        e.pred_mem_bytes_validated = int(predicted) if predicted else None
+        e.xla_peak_bytes = int(actual)
+    if predicted and actual:
+        _drift("peak_hbm", float(predicted) / float(actual))
+
+
+def note_cost_analysis(digest: Optional[str],
+                       analysis: Optional[Dict[str, Any]]) -> None:
+    """``st.explain``'s hook: XLA ``cost_analysis()`` FLOPs/bytes for
+    the compiled plan, recorded next to the model's cost."""
+    if digest is None or not analysis:
+        return
+    with _lock:
+        e = _get_or_create(digest)
+        try:
+            e.flops = float(analysis.get("flops", 0.0)) or e.flops
+            e.xla_bytes_accessed = (
+                float(analysis.get("bytes accessed", 0.0))
+                or e.xla_bytes_accessed)
+        except (TypeError, ValueError):
+            pass
+
+
+def ingest(digest: str, components: Dict[str, float],
+           measured_s: float, dp_cost: Optional[float] = None) -> None:
+    """Offline entry point: feed an externally measured schedule (a
+    profile run, a replayed trace, a synthetic workload) into the
+    ledger so :func:`fit_profile` can calibrate from it. ``dp_cost``
+    defaults to the uncalibrated model's prediction — the sum of the
+    components."""
+    with _lock:
+        e = _get_or_create(digest)
+        e.components = {k: float(v) for k, v in components.items()}
+        e.dp_cost = float(dp_cost if dp_cost is not None
+                          else sum(e.components.values()))
+        e.dispatch_count += 1
+        e.dispatch_total_s += measured_s
+        if e.dispatch_min_s is None or measured_s < e.dispatch_min_s:
+            e.dispatch_min_s = measured_s
+
+
+# -- the snapshot (st.ledger) --------------------------------------------
+
+
+def _validate_missing() -> int:
+    """Run ``resilience.memory.validate_plan`` for every live plan
+    that has no memory actuals yet (the ``st.ledger(validate=True)``
+    convenience — one AOT compile per un-validated plan)."""
+    with _lock:
+        todo = [(e.plan_ref() if e.plan_ref is not None else None)
+                for e in _entries.values() if e.xla_peak_bytes is None]
+    done = 0
+    for plan in todo:
+        if plan is None:
+            continue
+        try:
+            from ..resilience import memory as memory_mod  # lazy: obs
+            # sits below resilience in the layer order
+            if memory_mod.validate_plan(plan) is not None:
+                done += 1
+        except Exception:  # noqa: BLE001 - validation is advisory
+            continue
+    return done
+
+
+def snapshot(validate: bool = False) -> Dict[str, Any]:
+    """The public ``st.ledger()``: per-plan predictions, measurements
+    and measured-vs-predicted ratios, per-model aggregates (geometric
+    mean ratio, worst |log| deviation, drift counts), and the active
+    calibration state. Updates the Prometheus
+    ``calibration_error_ratio{model=...}`` gauges. ``validate=True``
+    first runs the memory validation for plans missing actuals."""
+    if validate:
+        _validate_missing()
+    with _lock:
+        entries = list(_entries.values())
+    # per-platform seconds-per-cost-unit: the median measured/predicted
+    # over entries with both sides (median: robust to one mismodeled
+    # plan polluting the scale every other ratio is read against)
+    pairs = [e.dispatch_min_s / e.dp_cost for e in entries
+             if e.dp_cost and e.dp_cost > 0 and e.dispatch_min_s]
+    scale = float(sorted(pairs)[len(pairs) // 2]) if pairs else None
+
+    plans: Dict[str, Any] = {}
+    logs: Dict[str, List[float]] = {m: [] for m in _MODELS}
+    for e in entries:
+        ratios: Dict[str, Optional[float]] = {}
+        if scale and e.dp_cost and e.dp_cost > 0 and e.dispatch_min_s:
+            r = (e.dp_cost * scale) / e.dispatch_min_s
+            ratios["tiling_dp"] = round(r, 4)
+            logs["tiling_dp"].append(math.log(r))
+        if e.xla_peak_bytes and e.pred_mem_bytes_validated:
+            r = e.pred_mem_bytes_validated / e.xla_peak_bytes
+            ratios["peak_hbm"] = round(r, 4)
+            logs["peak_hbm"].append(math.log(r))
+        if e.service_count and e.service_total_s > 0 \
+                and e.pred_service_total_s > 0:
+            r = e.pred_service_total_s / e.service_total_s
+            ratios["service_time"] = round(r, 4)
+            logs["service_time"].append(math.log(r))
+        plans[e.digest] = {
+            "root": e.root,
+            "predicted": {
+                "dp_cost": e.dp_cost,
+                "cost_components": e.components,
+                "peak_bytes": e.pred_peak_bytes,
+                "service_s": (
+                    round(e.pred_service_total_s / e.service_count, 6)
+                    if e.service_count else None),
+            },
+            "measured": {
+                "dispatch_count": e.dispatch_count,
+                "dispatch_min_s": e.dispatch_min_s,
+                "dispatch_mean_s": (
+                    round(e.dispatch_total_s / e.dispatch_count, 6)
+                    if e.dispatch_count else None),
+                "compile_s": e.compile_s,
+                "flops": e.flops,
+                "xla_bytes_accessed": e.xla_bytes_accessed,
+                "xla_peak_bytes": e.xla_peak_bytes,
+                "service_mean_s": (
+                    round(e.service_total_s / e.service_count, 6)
+                    if e.service_count else None),
+            },
+            "ratios": ratios,
+        }
+
+    models: Dict[str, Any] = {}
+    for m in _MODELS:
+        ls = logs[m]
+        rec: Dict[str, Any] = {
+            "samples": len(ls),
+            "drift_events": REGISTRY.counter(
+                labeled("calibration_drift_total", model=m)).value,
+        }
+        if ls:
+            gm = math.exp(sum(ls) / len(ls))
+            rec["calibration_error_ratio"] = round(gm, 4)
+            rec["worst_abs_log"] = round(max(abs(v) for v in ls), 4)
+            if _METRICS_FLAG._value:
+                REGISTRY.gauge(
+                    labeled("calibration_error_ratio", model=m),
+                    "geometric-mean predicted/measured ratio per cost "
+                    "model (1.0 = calibrated; scale-normalized for "
+                    "tiling_dp)").set(float(gm))
+        models[m] = rec
+    if scale is not None:
+        models["tiling_dp"]["seconds_per_cost_unit"] = scale
+
+    prof = _active_profile
+    return {
+        "plans": plans,
+        "models": models,
+        "drift_tol": FLAGS.calibration_drift_tol,
+        "calibration": {
+            "enabled": bool(FLAGS.cost_calibration),
+            "fingerprint": FLAGS.cost_calibration_fingerprint or None,
+            "profile": prof.to_dict() if prof is not None else None,
+        },
+    }
+
+
+def reset() -> None:
+    """Drop every ledger entry and the DP scale state (test isolation;
+    the active calibration profile is NOT touched — use
+    ``set_profile(None)``)."""
+    with _lock:
+        _entries.clear()
+        _dp_state["n"] = 0
+        _dp_state["log_scale"] = 0.0
+
+
+# -- profile-guided calibration ------------------------------------------
+
+
+class CalibrationProfile:
+    """Per-op-class multiplicative corrections for the tiling DP.
+
+    ``factors`` maps class names (:data:`CLASSES`) to relative
+    multipliers (cost-weighted mean ~1 over the fit set — the profile
+    reshapes the model's trade-offs, not its absolute scale). File
+    format (``st.save_profile`` / ``st.load_profile``)::
+
+        {"version": 1,
+         "factors": {"reshard": 4.1, "psum": 0.8, ...},
+         "meta": {"fitted_from_plans": 12, "platform": "cpu", ...}}
+    """
+
+    def __init__(self, factors: Dict[str, float],
+                 meta: Optional[Dict[str, Any]] = None):
+        self.factors = {str(k): float(v) for k, v in factors.items()
+                        if float(v) > 0}
+        self.meta = dict(meta or {})
+
+    def fingerprint(self) -> str:
+        """Stable short digest of the factor set — keyed into
+        ``_opt_flags_key`` via FLAGS.cost_calibration_fingerprint."""
+        import hashlib
+
+        blob = json.dumps(sorted((k, round(v, 6))
+                                 for k, v in self.factors.items()))
+        return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"version": 1, "factors": dict(self.factors),
+                "meta": dict(self.meta),
+                "fingerprint": self.fingerprint()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CalibrationProfile":
+        if int(d.get("version", 1)) != 1:
+            raise ValueError(
+                f"unsupported calibration profile version "
+                f"{d.get('version')!r}")
+        return cls(d.get("factors") or {}, d.get("meta"))
+
+    def __repr__(self) -> str:
+        body = ", ".join(f"{k}={v:.3g}"
+                         for k, v in sorted(self.factors.items()))
+        return f"CalibrationProfile({body})"
+
+
+_active_profile: Optional[CalibrationProfile] = None
+
+
+def set_profile(profile: Optional[CalibrationProfile]) -> None:
+    """Install (or clear) the active calibration profile. Writing the
+    fingerprint flag bumps the config mutation counter, which
+    invalidates ``expr/base``'s memoized flags key — every plan signed
+    after this call carries the new fingerprint."""
+    global _active_profile
+    _active_profile = profile
+    FLAGS.cost_calibration_fingerprint = (
+        profile.fingerprint() if profile is not None else "")
+
+
+def active_profile() -> Optional[CalibrationProfile]:
+    return _active_profile
+
+
+def factors() -> Optional[Dict[str, float]]:
+    """The active per-op-class factors when calibration is on, else
+    None (the tiling DP's one read per table build)."""
+    if not _CAL_FLAG._value:
+        return None
+    p = _active_profile
+    return p.factors if p is not None else None
+
+
+def fit_profile(min_dispatches: int = 1) -> Optional[CalibrationProfile]:
+    """Least-squares per-op-class factors from the ledger.
+
+    Each entry with a component decomposition and a measured dispatch
+    time contributes one row ``sum_c comp[c] * f_c ~= measured_s``;
+    the solution is clipped positive and normalized so the total
+    modeled cost over the fit set is unchanged (factors are relative).
+    Returns None when the ledger holds nothing fittable."""
+    import numpy as np
+
+    with _lock:
+        rows = [(dict(e.components), e.dispatch_min_s)
+                for e in _entries.values()
+                if e.components and e.dispatch_min_s
+                and e.dispatch_count >= min_dispatches]
+    if not rows:
+        return None
+    classes = sorted({c for comp, _ in rows for c in comp
+                      if comp.get(c, 0.0) > 0})
+    if not classes:
+        return None
+    a = np.array([[comp.get(c, 0.0) for c in classes]
+                  for comp, _ in rows], dtype=np.float64)
+    b = np.array([m for _, m in rows], dtype=np.float64)
+    # condition: scale each class column to unit mean so lstsq is not
+    # dominated by the class with the largest raw byte counts
+    col = a.mean(axis=0)
+    col[col <= 0] = 1.0
+    sol, *_ = np.linalg.lstsq(a / col, b, rcond=None)
+    sol = np.clip(sol / col, 1e-12, None)
+    denom = float((a * sol).sum())
+    base = float(a.sum())
+    if denom <= 0 or base <= 0:
+        return None
+    f = np.clip(sol * (base / denom), 0.01, 100.0)
+    factors_ = {c: float(f[i]) for i, c in enumerate(classes)}
+    return CalibrationProfile(factors_, meta={
+        "fitted_from_plans": len(rows), "classes": classes})
+
+
+def save_profile(path: str,
+                 profile: Optional[CalibrationProfile] = None) -> str:
+    """Persist a calibration profile as JSON: the given one, else the
+    active one, else a fresh fit from the ledger. Returns the path."""
+    profile = profile or _active_profile or fit_profile()
+    if profile is None:
+        raise ValueError(
+            "no calibration profile to save: none is active and the "
+            "ledger holds no fittable entries (run some plans with "
+            "FLAGS.cost_ledger on, or pass a profile explicitly)")
+    with open(path, "w") as fh:
+        json.dump(profile.to_dict(), fh, indent=2, sort_keys=True)
+    return path
+
+
+def load_profile(path: str) -> CalibrationProfile:
+    """Load a profile from ``path`` and install it as the active one
+    (enable application with ``FLAGS.cost_calibration = True``)."""
+    with open(path) as fh:
+        profile = CalibrationProfile.from_dict(json.load(fh))
+    set_profile(profile)
+    return profile

@@ -45,17 +45,34 @@ FLAGS.define_int(
     "(count/sum/max are exact and unwindowed).")
 
 
+def escape_label_value(v: Any) -> str:
+    """Prometheus exposition-format label-value escaping (backslash,
+    double quote, newline): a hostile tenant label cannot break a
+    scrape line or smuggle a fake series."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """``# HELP`` text escaping per the exposition format (backslash
+    and newline only)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def labeled(name: str, **labels: Any) -> str:
     """Canonical instrument name carrying Prometheus-style labels:
     ``labeled("serve_requests", tenant="acme")`` ->
     ``serve_requests{tenant="acme"}``. Labels are sorted so the same
-    label set always maps to the same instrument, and ``prometheus()``
-    renders the label block natively (one TYPE line per base name).
-    The serve layer keys its per-tenant counters through this."""
+    label set always maps to the same instrument, values are escaped
+    per the exposition format at definition time (the canonical key IS
+    the rendered form), and ``prometheus()`` renders the label block
+    natively (one TYPE line per base name). The serve layer keys its
+    per-tenant counters through this."""
     if not labels:
         return name
     body = ",".join(
-        f'{k}="{str(v)}"' for k, v in sorted(labels.items()))
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
     return f"{name}{{{body}}}"
 
 
@@ -65,6 +82,39 @@ def split_labels(key: str) -> tuple:
     if i < 0:
         return key, ""
     return key[:i], key[i:]
+
+
+def parse_labels(key: str) -> tuple:
+    """Escape-aware inverse of :func:`labeled`: ``(base name, {label:
+    unescaped value})``. Also parses rendered exposition series names
+    — the round-trip the hostile-label test exercises, and how the
+    flight recorder recovers tenants from histogram keys."""
+    base, block = split_labels(key)
+    out: Dict[str, str] = {}
+    i = 1  # past '{'
+    n = len(block)
+    while 0 < i < n and block[i] != "}":
+        j = block.find("=", i)
+        if j < 0 or j + 1 >= n or block[j + 1] != '"':
+            break
+        label = block[i:j]
+        i = j + 2  # past ="
+        val: List[str] = []
+        while i < n and block[i] != '"':
+            ch = block[i]
+            if ch == "\\" and i + 1 < n:
+                nxt = block[i + 1]
+                val.append({"\\": "\\", '"': '"', "n": "\n"}.get(
+                    nxt, "\\" + nxt))
+                i += 2
+            else:
+                val.append(ch)
+                i += 1
+        out[label] = "".join(val)
+        i += 1  # past closing quote
+        if i < n and block[i] == ",":
+            i += 1
+    return base, out
 
 
 class Counter:
@@ -225,10 +275,19 @@ class Registry:
     def prometheus(self) -> str:
         """Prometheus text exposition format (0.0.4). Instruments named
         through :func:`labeled` render their label block natively, with
-        one ``# TYPE`` line per base metric (per-tenant serve counters
-        become ``spartan_serve_requests{tenant="..."} N`` series)."""
+        one ``# HELP`` (when the instrument carries help text, escaped
+        per the format) + ``# TYPE`` pair per base metric (per-tenant
+        serve counters become ``spartan_serve_requests{tenant="..."} N``
+        series; label values were escaped at :func:`labeled` time)."""
         lines: List[str] = []
         typed: set = set()
+        with self._lock:
+            helps: Dict[str, str] = {}
+            for table in (self._counters, self._gauges, self._hists):
+                for key, inst in table.items():
+                    base, _ = split_labels(key)
+                    if inst.help and base not in helps:
+                        helps[base] = inst.help
 
         def _name(raw: str) -> str:
             safe = "".join(ch if (ch.isalnum() or ch == "_") else "_"
@@ -240,6 +299,9 @@ class Registry:
             n = _name(base)
             if (n, kind) not in typed:
                 typed.add((n, kind))
+                if base in helps:
+                    lines.append(
+                        f"# HELP {n} {_escape_help(helps[base])}")
                 lines.append(f"# TYPE {n} {kind}")
             return n + labels
 
@@ -261,6 +323,9 @@ class Registry:
             n = _name(base)
             if (n, "summary") not in typed:
                 typed.add((n, "summary"))
+                if base in helps:
+                    lines.append(
+                        f"# HELP {n} {_escape_help(helps[base])}")
                 lines.append(f"# TYPE {n} summary")
             q1 = labels[:-1] + ',quantile="0.5"}' if labels else \
                 '{quantile="0.5"}'

@@ -799,9 +799,27 @@ def dump_crash(path: Optional[str] = None, reason: str = "",
                 "checks": len(w.series),
                 "last": (w.series[-1] if w.series else None)}
                for w in _WATCHPOINTS]
+    # flight recorder + cost ledger forensics ride every crash dump
+    # (and therefore bench.py's SIGTERM handler): WHICH requests were
+    # in flight when the process died, and how far the cost models had
+    # drifted. Advisory — a dump must never fail on them.
+    from . import flight as flight_mod
+    from . import ledger as ledger_mod
+
+    try:
+        flightrec: Optional[Dict[str, Any]] = flight_mod.snapshot(
+            limit=128)
+    except Exception:  # noqa: BLE001 - forensics are best-effort
+        flightrec = None
+    try:
+        ledger: Optional[Dict[str, Any]] = ledger_mod.snapshot()
+    except Exception:  # noqa: BLE001
+        ledger = None
     doc: Dict[str, Any] = {
         "reason": reason,
         "pid": os.getpid(),
+        "flightrec": flightrec,
+        "ledger": ledger,
         # the non-default FLAGS in force when the process died: lets a
         # post-mortem attribute a regression/hang to a flag default
         # (ROADMAP r05 cold-start suspicion) without re-running

@@ -23,12 +23,13 @@ layers — so every subsystem can emit spans without import cycles.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, Iterator, List, Optional
 
 from ..utils.config import FLAGS
 
@@ -56,6 +57,36 @@ def now() -> float:
 
 
 _EPOCH = now()  # process trace epoch: span .ts is microseconds since this
+
+
+def epoch() -> float:
+    """The process trace epoch on the tracer clock — lets other obs
+    modules (flight recorder) report timestamps on the same axis as
+    span ``ts`` values."""
+    return _EPOCH
+
+
+@contextlib.contextmanager
+def device_profile(trace_dir: str) -> Iterator[None]:
+    """The ONE sanctioned ``jax.profiler.trace`` entry point (lint
+    rule 9: raw jax.profiler use outside obs/ escapes the ledger's
+    book-keeping of what was measured when). Captures a device profile
+    into ``trace_dir`` (view in TensorBoard / Perfetto)."""
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named host range visible inside a :func:`device_profile`
+    capture (``jax.profiler.TraceAnnotation`` — same single-sourcing
+    as :func:`device_profile`)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
 
 
 class Span:

@@ -65,6 +65,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs import ledger as ledger_mod
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY
 from ..utils import profiling as prof
@@ -461,6 +462,10 @@ def validate_plan(plan: Any, mesh=None,
         "error_ratio": (round(ratio, 4) if ratio is not None else None),
     }
     mem["validation"] = result
+    # cost ledger: the peak-HBM model's actuals feed — predicted vs
+    # XLA-reported peak per plan digest (st.ledger closes the loop)
+    ledger_mod.note_memory_actual(plan.report.get("plan_key"),
+                                  predicted, actual)
     if _METRICS_FLAG._value and ratio is not None:
         REGISTRY.counter(
             "memory_validations",

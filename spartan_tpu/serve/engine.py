@@ -43,8 +43,11 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..expr import base
+from ..obs import flight as flight_mod
+from ..obs import ledger as ledger_mod
 from ..obs import numerics as numerics_mod
 from ..obs import trace as trace_mod
+from ..obs.explain import key_hash
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY, labeled
 from ..parallel import mesh as mesh_mod
@@ -142,11 +145,16 @@ class _MemoryLedger:
 
 class _Request:
     """One queued evaluation. Signed at submit time (caller thread) so
-    workers can group by plan signature without re-traversing."""
+    workers can group by plan signature without re-traversing. Minted
+    with a flight-recorder request id (obs/flight.py) that every
+    lifecycle event — queue, coalesce, dispatch, resolve, fetch —
+    carries; ``t_taken``/``t_dispatch`` stamps feed the per-tenant
+    latency decomposition."""
 
     __slots__ = ("expr", "donate", "tenant", "deadline", "future",
                  "plan_key", "leaves", "mesh", "coalescable",
-                 "t_submit", "taken", "mem_bytes")
+                 "t_submit", "taken", "mem_bytes", "rid", "t_taken",
+                 "t_dispatch", "via")
 
     def __init__(self, expr: Any, donate: List[Any],
                  tenant: Optional[str], deadline_s: Optional[float],
@@ -169,6 +177,14 @@ class _Request:
         self.coalescable = (not donate and not any(
             arr is not None and arr._donate_next
             for arr in (base._leaf_array(l) for l in self.leaves)))
+        self.rid = flight_mod.mint_rid()
+        self.t_taken = 0.0
+        self.t_dispatch = 0.0
+        self.via = "head"  # how a batch got this request (flight rec)
+        self.future.rid = self.rid
+        if flight_mod._FLIGHT_FLAG._value:
+            flight_mod.note(self.rid, "submit", tenant=tenant,
+                            plan=key_hash(self.plan_key))
 
     def remaining_s(self) -> Optional[float]:
         if self.deadline is None:
@@ -233,6 +249,7 @@ class ServeEngine:
         self._stop.set()
         self.queue.close()  # wakes idle workers blocked on the CV
         for r in self.queue.drain():
+            flight_mod.note(r.rid, "drain", reason="stop")
             r.future._reject(RuntimeError("serve engine stopped"))
         for t in threads:
             t.join(timeout)
@@ -255,6 +272,7 @@ class ServeEngine:
         self._reconfiguring = float(retry_after_s)
         drained = self.queue.drain()
         for r in drained:
+            flight_mod.note(r.rid, "drain", reason="reconfiguring")
             r.future._reject(MeshReconfiguring(
                 retry_after_s, "request drained before dispatch"))
         if drained and _METRICS_FLAG._value:
@@ -311,12 +329,18 @@ class ServeEngine:
                         "serve_mem_rejected",
                         "submissions shed because their predicted "
                         "peak would overflow the HBM budget").inc()
+                flight_mod.note(req.rid, "reject", reason="memory")
                 raise Backpressure(
                     self.queue.depth(),
                     self.queue.retry_after_s(self.workers))
         if not self.running:
             self.start()
-        self.queue.put(req, workers=self.workers)
+        try:
+            self.queue.put(req, workers=self.workers)
+        except Backpressure:
+            flight_mod.note(req.rid, "reject", reason="backpressure")
+            raise
+        flight_mod.note(req.rid, "enqueue", depth=self.queue.depth())
         return req.future
 
     def stats(self) -> Dict[str, Any]:
@@ -345,12 +369,21 @@ class ServeEngine:
             req = self.queue.pop()
             if req is None:
                 continue
+            req.t_taken = trace_mod.now()
+            # the service-time PREDICTION for this request is the EMA
+            # as of pop — exactly what a Backpressure retry-after would
+            # have quoted; the cost ledger pairs it with the measured
+            # service below
+            predicted_s = self.queue.ema_service_s()
             with prof.stopwatch() as sw:
                 try:
                     self._service(req)
                 except Exception as e:  # belt: _service resolves futures
                     req.future._reject(e)
             self.queue.note_service_time(sw.elapsed)
+            if ledger_mod._LEDGER_FLAG._value:
+                ledger_mod.note_service(key_hash(req.plan_key),
+                                        predicted_s, sw.elapsed)
 
     def _shed_expired(self, batch: List[_Request]) -> List[_Request]:
         live: List[_Request] = []
@@ -362,6 +395,7 @@ class ServeEngine:
                         "serve_deadline_expired",
                         "requests shed because their deadline expired "
                         "before dispatch").inc()
+                flight_mod.note(r.rid, "shed", reason="deadline")
                 r.future._reject(DeadlineExceeded(
                     f"deadline expired {-rem * 1e3:.1f}ms before "
                     f"dispatch (queued {trace_mod.now() - r.t_submit:.3f}s)"))
@@ -369,16 +403,30 @@ class ServeEngine:
                 live.append(r)
         return live
 
+    def _take(self, req: _Request, limit: int,
+              via: str) -> List[_Request]:
+        """Pull same-signature companions for ``req``'s batch, stamping
+        each with its taken time and HOW it joined ('queued' = already
+        waiting at pop time, 'window' = arrived during the linger) —
+        the flight recorder's coalescing provenance."""
+        more = self.queue.take_matching(req.plan_key, limit)
+        if more:
+            now = trace_mod.now()
+            for r in more:
+                r.t_taken = now
+                r.via = via
+        return more
+
     def _service(self, req: _Request) -> None:
         batch = [req]
         if self.coalesce_requests and req.coalescable:
-            batch += self.queue.take_matching(
-                req.plan_key, self.max_batch - len(batch))
+            batch += self._take(req, self.max_batch - len(batch),
+                                "queued")
             if len(batch) < self.max_batch and self.batch_window_s > 0:
                 # linger once for stragglers inside the batching window
                 self.queue.wait_for_more(self.batch_window_s)
-                batch += self.queue.take_matching(
-                    req.plan_key, self.max_batch - len(batch))
+                batch += self._take(req, self.max_batch - len(batch),
+                                    "window")
         batch = self._shed_expired(batch)
         if not batch:
             return
@@ -424,6 +472,11 @@ class ServeEngine:
                          "falling back to %d solo dispatch(es), "
                          "mode=%s", type(e).__name__, str(e)[:120],
                          len(chunk), mode)
+                if flight_mod._FLIGHT_FLAG._value:
+                    for r in chunk:
+                        flight_mod.note(r.rid, "fallback",
+                                        reason=type(e).__name__,
+                                        mode=mode)
                 for r in chunk:
                     self._solo(r)
 
@@ -431,6 +484,17 @@ class ServeEngine:
         deadlines = [r.remaining_s() for r in batch]
         tightest = min((d for d in deadlines if d is not None),
                        default=None)
+        # one dispatch span id for the whole batch: every member's
+        # flight record names WHICH dispatch resolved it and why it
+        # was in this batch (its 'via' stamp from _take / head pop)
+        span = flight_mod.mint_span()
+        t0 = trace_mod.now()
+        record = flight_mod._FLIGHT_FLAG._value
+        for r in batch:
+            r.t_dispatch = t0
+            if record:
+                flight_mod.note(r.rid, "coalesce", span=span,
+                                batch=len(batch), via=r.via)
         # one reservation for the whole batch: each request brings its
         # own predicted peak (the leading client axis scales working
         # sets ~linearly; the batch program is not re-modeled —
@@ -447,13 +511,35 @@ class ServeEngine:
         for r, res in zip(batch, results):
             r.future.coalesced = len(batch)
             r.future._resolve(res)
+            self._flight_resolve(r, span, len(batch), "ok")
+
+    def _flight_resolve(self, r: _Request, span: int, batch: int,
+                        status: str) -> None:
+        """One resolution record: the request's latency decomposition
+        (queue-wait / coalesce-wait / dispatch) lands in its flight
+        record and the per-tenant histograms."""
+        if not flight_mod._FLIGHT_FLAG._value:
+            return
+        flight_mod.record_resolution(
+            rid=r.rid, tenant=r.tenant, span=span, batch=batch,
+            status=status, t_submit=r.t_submit,
+            t_taken=r.t_taken or r.t_submit,
+            t_dispatch=r.t_dispatch or r.t_taken or r.t_submit,
+            t_resolved=r.future.t_resolved)
 
     def _solo(self, r: _Request) -> None:
+        span = flight_mod.mint_span()
+        r.t_dispatch = trace_mod.now()
+        if flight_mod._FLIGHT_FLAG._value:
+            flight_mod.note(r.rid, "dispatch", span=span, batch=1,
+                            via=r.via)
         self.ledger.reserve(r.mem_bytes)
         try:
             self._solo_inner(r)
         finally:
             self.ledger.release(r.mem_bytes)
+        self._flight_resolve(
+            r, span, 1, "ok" if r.future._exc is None else "error")
 
     def _solo_inner(self, r: _Request) -> None:
         with mesh_mod.use_mesh(r.mesh), \

@@ -73,7 +73,7 @@ class EvalFuture:
     is actually awaited."""
 
     __slots__ = ("_event", "_result", "_exc", "_callbacks", "_lock",
-                 "tenant", "coalesced", "t_submit", "t_resolved")
+                 "tenant", "coalesced", "t_submit", "t_resolved", "rid")
 
     def __init__(self, tenant: Optional[str] = None):
         self._event = threading.Event()
@@ -89,6 +89,10 @@ class EvalFuture:
         # t_resolved - t_submit is the request's serving latency
         self.t_submit: float = 0.0
         self.t_resolved: float = 0.0
+        # flight-recorder request id (obs/flight.py), minted at submit
+        # and shared with every event of this request's lifecycle;
+        # 0 = not a recorded request (bare futures)
+        self.rid: int = 0
 
     # -- caller side ----------------------------------------------------
 
@@ -112,11 +116,21 @@ class EvalFuture:
 
     def glom(self, timeout: Optional[float] = None) -> Any:
         """Resolve AND fetch: the one call that blocks on device
-        execution (``result()`` returns an async array handle)."""
+        execution (``result()`` returns an async array handle). The
+        fetch wall time is the last hop of this request's flight
+        record (per-tenant ``serve_fetch_s`` histogram)."""
         out = self.result(timeout)
+        from ..obs import flight as flight_mod
+        from ..obs import trace as trace_mod
+
+        t0 = trace_mod.now()
         if isinstance(out, tuple):
-            return tuple(o.glom() for o in out)
-        return out.glom()
+            fetched: Any = tuple(o.glom() for o in out)
+        else:
+            fetched = out.glom()
+        flight_mod.note_fetch(self.rid, self.tenant,
+                              trace_mod.now() - t0)
+        return fetched
 
     def add_done_callback(self, fn: Callable[["EvalFuture"], None]
                           ) -> None:

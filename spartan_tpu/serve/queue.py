@@ -59,6 +59,15 @@ class AdmissionQueue:
         with self._cv:
             self._ema_service_s += 0.2 * (seconds - self._ema_service_s)
 
+    def ema_service_s(self) -> float:
+        """The queue's service-time PREDICTION: the current EMA of
+        per-request service seconds. Read by the Backpressure
+        retry-after estimate and — before each service — by the cost
+        ledger, so the prediction the client's backoff was based on is
+        recorded next to the measured service time (unlocked read: a
+        stale EMA is still the value the estimate used)."""
+        return self._ema_service_s
+
     def retry_after_s(self, workers: int) -> float:
         """Expected time until the current backlog drains one slot."""
         return max(0.001,

@@ -232,6 +232,20 @@ FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force()."
 #       plan report + last health word to crash_dump_path.
 #   crash_dump_path      (obs/numerics.py, default "") — crash-report
 #       destination (empty = spartan_tpu_crash_<pid>.json in tmp).
+#   cost_ledger          (obs/ledger.py, default True) — record
+#       predicted-vs-measured cost per plan (st.ledger); disabled it
+#       costs one flag read per dispatch (calibration_overhead gate).
+#   cost_ledger_max / calibration_drift_tol (obs/ledger.py, defaults
+#       256 / log 2) — ledger entry bound; drift tolerance on
+#       |log(pred/actual)| per model before the drift counter bumps.
+#   cost_calibration     (obs/ledger.py, default False) — multiply the
+#       active profile's per-op-class factors into the tiling DP;
+#       cost_calibration_fingerprint (set by st.load_profile) keys
+#       calibrated plans apart in the plan/compile caches.
+#   flightrec / flightrec_ring (obs/flight.py, defaults True / 4096)
+#       — per-request serve-path flight recorder (st.flightrec):
+#       submit -> queue -> coalesce -> dispatch -> resolve -> fetch
+#       events, ring-bounded, no new locks on the hot paths.
 # The resilience layer's switches (spartan_tpu/resilience/) likewise
 # live with their consumers (docs/RESILIENCE.md):
 #   resilience           (engine.py, default True)  — master switch for

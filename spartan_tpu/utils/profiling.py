@@ -35,13 +35,18 @@ import numpy as np
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY
 from ..obs.trace import SpanCtx as _SpanCtx
+from ..obs.trace import annotate as _obs_annotate
+from ..obs.trace import device_profile as _obs_device_profile
 from ..obs.trace import span as _obs_span
 from .config import FLAGS
 from .log import log_info
 
-# re-exported so call sites can say ``prof.span(...)`` without importing
-# obs directly (obs.trace.span is the one span implementation)
+# re-exported so call sites can say ``prof.span(...)`` /
+# ``prof.device_profile(...)`` without importing obs directly
+# (obs.trace owns the one span implementation AND the one sanctioned
+# jax.profiler entry points — lint rule 9)
 span = _obs_span
+device_profile = _obs_device_profile
 
 # -- plan-cache counters and per-phase timers ----------------------------
 #
@@ -172,9 +177,10 @@ def plan_cache_stats() -> Dict[str, Any]:
 
 @contextlib.contextmanager
 def profile_trace(trace_dir: Optional[str] = None) -> Iterator[None]:
-    """Capture a jax.profiler trace (view in TensorBoard/Perfetto)."""
+    """Capture a device profiler trace (view in TensorBoard/Perfetto)
+    via the sanctioned ``obs.trace.device_profile`` entry point."""
     trace_dir = trace_dir or FLAGS.profile_dir
-    with jax.profiler.trace(trace_dir):
+    with _obs_device_profile(trace_dir):
         yield
     log_info("profiler trace written to %s", trace_dir)
 
@@ -202,11 +208,12 @@ def _compiled(expr):
 
 def cost_analysis(expr) -> Dict[str, float]:
     """FLOPs / bytes-accessed estimate of an expr's compiled program
-    (the per-expr HLO cost hook of SURVEY.md §5)."""
-    analysis = _compiled(expr).cost_analysis()
-    if isinstance(analysis, list):
-        analysis = analysis[0] if analysis else {}
-    return dict(analysis or {})
+    (the per-expr HLO cost hook of SURVEY.md §5). The read-out goes
+    through ``obs.explain.compiled_cost_analysis`` — the one
+    sanctioned ``cost_analysis()`` call site (lint rule 9)."""
+    from ..obs.explain import compiled_cost_analysis
+
+    return compiled_cost_analysis(_compiled(expr))
 
 
 def hlo_text(expr) -> str:
@@ -243,6 +250,7 @@ def device_memory_stats() -> Dict[str, Any]:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named span visible in profiler traces."""
-    with jax.profiler.TraceAnnotation(name):
+    """Named span visible in profiler traces (delegates to the
+    sanctioned ``obs.trace.annotate``)."""
+    with _obs_annotate(name):
         yield

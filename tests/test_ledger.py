@@ -1,0 +1,261 @@
+"""Device-time cost ledger + profile-guided calibration (ISSUE 9):
+predictions recorded next to measurements for all three models
+(tiling-DP cost, peak HBM, service time), drift counting, per-op-class
+factor fitting, and the calibration flag flipping a tiling-DP choice
+under plan-key separation."""
+
+import math
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import distarray as da
+from spartan_tpu.array import tiling as tiling_mod
+from spartan_tpu.expr import base, tiling_cost
+from spartan_tpu.obs import ledger
+from spartan_tpu.obs.explain import key_hash
+from spartan_tpu.obs.metrics import REGISTRY, labeled
+from spartan_tpu.utils.config import FLAGS
+
+
+@pytest.fixture(autouse=True)
+def _setup(mesh1d):
+    saved = {n: getattr(FLAGS, n) for n in (
+        "cost_ledger", "cost_calibration",
+        "cost_calibration_fingerprint", "calibration_drift_tol")}
+    FLAGS.cost_ledger = True
+    ledger.set_profile(None)
+    ledger.reset()
+    st.serve.shutdown_default()
+    yield
+    st.serve.shutdown_default()
+    ledger.set_profile(None)
+    ledger.reset()
+    for n, v in saved.items():
+        setattr(FLAGS, n, v)
+
+
+def _leaves(seed=0):
+    rng = np.random.RandomState(seed)
+    x = st.as_expr(rng.rand(256, 64).astype(np.float32)).evaluate()
+    y = st.as_expr(rng.rand(256, 64).astype(np.float32)).evaluate()
+    a = st.as_expr(rng.rand(128, 128).astype(np.float32)).evaluate()
+    w = st.as_expr(rng.rand(128, 128).astype(np.float32)).evaluate()
+    return x, y, a, w
+
+
+def _matrix(name, x, y, a, w):
+    """Fresh structurally-identical exprs per call (results cache on
+    nodes, so reuse would skip the dispatch being measured)."""
+    xe, ye, ae, we = (st.as_expr(v) for v in (x, y, a, w))
+    if name == "map":
+        return (xe + ye) * 3.0 - xe
+    if name == "dot":
+        return st.dot(ae, ae)
+    if name == "reduce":
+        return (xe * xe).sum(axis=0)
+    return st.loop(4, lambda c: c * 0.5 + ae, we)
+
+
+NAMES = ("map", "dot", "reduce", "loop")
+
+
+# -- the loop-closing acceptance test ------------------------------------
+
+
+def test_ledger_closes_loop_on_cpu_matrix():
+    """For the {map, dot, reduce, loop} plans, st.ledger() reports
+    measured-vs-predicted ratios for ALL THREE models: the tiling DP
+    (scale-normalized dispatch time), peak HBM (XLA memory_analysis
+    actuals), and service time (queue EMA vs measured service)."""
+    leaves = _leaves()
+    digests = {}
+    for name in NAMES:
+        for _ in range(3):  # compile once, then measured warm hits
+            _matrix(name, *leaves).evaluate()
+        digests[name] = key_hash(
+            base.plan_signature(_matrix(name, *leaves))[0])
+
+    with st.ServeEngine(workers=1, batch_window_s=0.0) as eng:
+        for name in NAMES:
+            eng.submit(_matrix(name, *leaves),
+                       tenant="cal").result(timeout=120)
+
+    snap = st.ledger(validate=True)
+    for name, dig in digests.items():
+        plan = snap["plans"].get(dig)
+        assert plan is not None, (name, dig, sorted(snap["plans"]))
+        for model in ("tiling_dp", "peak_hbm", "service_time"):
+            r = plan["ratios"].get(model)
+            assert r is not None and r > 0 and math.isfinite(r), \
+                (name, model, plan)
+        # predictions and measurements sit side by side
+        assert plan["predicted"]["dp_cost"] > 0
+        assert plan["predicted"]["cost_components"]
+        assert plan["measured"]["dispatch_count"] >= 2
+        assert plan["measured"]["xla_peak_bytes"] > 0
+    models = snap["models"]
+    for model in ("tiling_dp", "peak_hbm", "service_time"):
+        assert models[model]["samples"] >= 4
+        assert models[model]["calibration_error_ratio"] > 0
+    assert models["tiling_dp"]["seconds_per_cost_unit"] > 0
+
+
+def test_prometheus_gauges_per_model():
+    leaves = _leaves(seed=1)
+    for _ in range(3):
+        _matrix("map", *leaves).evaluate()
+    st.ledger(validate=True)
+    text = st.metrics(fmt="prometheus")
+    assert 'spartan_calibration_error_ratio{model="tiling_dp"}' in text
+    assert 'spartan_calibration_error_ratio{model="peak_hbm"}' in text
+
+
+def test_compile_and_dispatch_recorded_separately():
+    # structurally identical plans from other tests would hit the
+    # process-wide caches and skip the compile being asserted on
+    base.clear_compile_cache()
+    leaves = _leaves(seed=2)
+    for _ in range(3):
+        _matrix("reduce", *leaves).evaluate()
+    dig = key_hash(base.plan_signature(_matrix("reduce", *leaves))[0])
+    plan = st.ledger()["plans"][dig]
+    meas = plan["measured"]
+    assert meas["compile_s"] and meas["compile_s"] > 0
+    assert meas["dispatch_count"] == 2  # first run was the compile
+    assert meas["dispatch_min_s"] > 0
+    assert meas["compile_s"] > meas["dispatch_min_s"]
+
+
+def test_drift_counter_fires_past_tolerance():
+    FLAGS.calibration_drift_tol = 0.1
+    before = REGISTRY.counter(
+        labeled("calibration_drift_total", model="service_time")).value
+    # prediction 5x off the measurement: |log 5| > 0.1
+    ledger.note_service("plan-x", predicted_s=0.5, measured_s=0.1)
+    after = REGISTRY.counter(
+        labeled("calibration_drift_total", model="service_time")).value
+    assert after == before + 1
+    # within tolerance: no drift
+    ledger.note_service("plan-x", predicted_s=0.1, measured_s=0.1)
+    assert REGISTRY.counter(labeled(
+        "calibration_drift_total",
+        model="service_time")).value == after
+
+
+def test_ledger_off_records_nothing():
+    FLAGS.cost_ledger = False
+    leaves = _leaves(seed=3)
+    for _ in range(2):
+        _matrix("map", *leaves).evaluate()
+    assert st.ledger()["plans"] == {}
+
+
+# -- profile fitting + persistence ---------------------------------------
+
+
+def _synthetic_rows(true_factors, rows=12, seed=7, scale=1e-6):
+    """Ledger entries whose measured times follow a SKEWED cost model:
+    measured = sum_c true_factors[c] * components[c] * scale."""
+    rng = np.random.RandomState(seed)
+    classes = sorted(true_factors)
+    for i in range(rows):
+        comp = {c: float(rng.uniform(10.0, 100.0)) for c in classes}
+        measured = scale * sum(true_factors[c] * comp[c]
+                               for c in classes)
+        ledger.ingest(f"syn-{i}", comp, measured)
+
+
+def test_fit_profile_recovers_relative_skew():
+    true = {"map": 1.0, "contraction": 1.0, "reshard": 4.0, "psum": 1.0}
+    _synthetic_rows(true)
+    prof = ledger.fit_profile()
+    assert prof is not None
+    # factors are relative (cost-weighted mean ~1): the SKEW between
+    # classes is what must be recovered
+    ratio = prof.factors["reshard"] / prof.factors["map"]
+    assert 3.2 < ratio < 4.8, prof.factors
+    ratio2 = prof.factors["psum"] / prof.factors["map"]
+    assert 0.8 < ratio2 < 1.25, prof.factors
+
+
+def test_profile_save_load_roundtrip(tmp_path):
+    prof = ledger.CalibrationProfile({"reshard": 2.5, "psum": 0.5},
+                                     meta={"platform": "cpu"})
+    path = str(tmp_path / "profile.json")
+    st.save_profile(path, prof)
+    loaded = st.load_profile(path)
+    assert loaded.factors == prof.factors
+    assert loaded.fingerprint() == prof.fingerprint()
+    # load_profile installs: the fingerprint flag now keys plan keys
+    assert FLAGS.cost_calibration_fingerprint == prof.fingerprint()
+    assert ledger.active_profile() is loaded
+
+
+def test_save_profile_fits_from_ledger_when_none_active(tmp_path):
+    _synthetic_rows({"map": 1.0, "reshard": 3.0})
+    path = st.save_profile(str(tmp_path / "fitted.json"))
+    loaded = st.load_profile(path)
+    assert loaded.factors["reshard"] / loaded.factors["map"] > 2.0
+
+
+# -- the calibration flip (acceptance) -----------------------------------
+
+
+def _gemm(seed=5, n=64):
+    rng = np.random.RandomState(seed)
+    a = da.from_numpy(rng.rand(n, n).astype(np.float32),
+                      tiling=tiling_mod.row(2))
+    b = da.from_numpy(rng.rand(n, n).astype(np.float32),
+                      tiling=tiling_mod.row(2))
+    return lambda: st.dot(st.as_expr(a), st.as_expr(b))
+
+
+def _best(build):
+    costs = tiling_cost.gemm_plan_costs(build())
+    (_node, ranked), = costs.items()
+    t, s, _cost = ranked[0]
+    return t.axes, s
+
+
+def test_calibration_flips_dp_choice_with_plan_key_separation():
+    """The synthetic skewed-cost workload: measurements say output
+    all-reduces (psum) cost ~10x what the uncalibrated model charges.
+    The profile FITTED from those measurements must flip the tiling
+    DP's GEMM strategy (psum-merged contraction -> gathered operands),
+    re-key the plan, and leave the numerics unchanged."""
+    build = _gemm()
+    grid0, strat0 = _best(build)
+    assert strat0 is not None  # uncalibrated: psum-merged contraction
+    key0 = base.plan_signature(build())[0]
+
+    # ledger entries measured under the skewed truth -> fitted profile
+    _synthetic_rows({"map": 1.0, "contraction": 1.0, "reshard": 1.0,
+                     "psum": 10.0})
+    prof = ledger.fit_profile()
+    assert prof.factors["psum"] / prof.factors["reshard"] > 7.0
+    ledger.set_profile(prof)
+    FLAGS.cost_calibration = True
+
+    grid1, strat1 = _best(build)
+    assert (grid1, strat1) != (grid0, strat0)
+    assert strat1 is None  # calibrated: gather operands, skip the psum
+
+    # plan-key separation: calibrated plans never alias uncalibrated
+    key1 = base.plan_signature(build())[0]
+    assert key0 != key1
+    v1 = np.asarray(build().glom())
+    FLAGS.cost_calibration = False
+    key2 = base.plan_signature(build())[0]
+    assert key2 == key0
+    v0 = np.asarray(build().glom())
+    np.testing.assert_allclose(v0, v1, rtol=1e-5)
+
+
+def test_calibration_without_profile_is_identity():
+    build = _gemm(seed=6)
+    best0 = _best(build)
+    FLAGS.cost_calibration = True  # on, but no profile installed
+    assert ledger.factors() is None
+    assert _best(build) == best0

@@ -271,6 +271,46 @@ def test_catches_raw_memory_stats(tmp_path):
     assert all("device_memory_aggregate" in f.message for f in findings)
 
 
+def test_catches_raw_profiling(tmp_path):
+    bad = tmp_path / "measurer.py"
+    bad.write_text(
+        "import jax\n"
+        "import jax.profiler\n"
+        "from jax.profiler import start_trace\n"
+        "with jax.profiler.trace('/tmp/t'):\n"
+        "    pass\n"
+        "flops = compiled.cost_analysis()\n"
+        "mem = compiled.memory_analysis()\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_raw_profiling(str(bad), tree)
+    # import jax.profiler + from jax.profiler import + the attribute
+    # use inside the with + the two introspection calls
+    assert sum(f.rule == "raw-profiling" for f in findings) == 5
+    # ... and the sanctioned entry points are named in the remedy
+    assert all("ledger" in f.message for f in findings)
+
+
+def test_raw_profiling_allowed_in_owners():
+    tree = ast.parse(
+        "import jax\n"
+        "with jax.profiler.trace('/tmp/t'):\n"
+        "    pass\n"
+        "a = compiled.cost_analysis()\n"
+        "m = compiled.memory_analysis()\n")
+    for rel in (os.path.join("spartan_tpu", "obs", "trace.py"),
+                os.path.join("spartan_tpu", "obs", "explain.py"),
+                os.path.join("spartan_tpu", "resilience", "memory.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_raw_profiling(path, tree) == []
+    # non-call attribute reads (docs, function defs) are NOT flagged,
+    # and unrelated .profiler attributes (not jax's) pass
+    other = ast.parse("fn = obj.cost_analysis\n"
+                      "p = torch.profiler\n"
+                      "def cost_analysis(expr):\n"
+                      "    return None\n")
+    assert lint_repo.lint_raw_profiling("/x/y.py", other) == []
+
+
 def test_raw_memory_stats_allowed_in_owners(tmp_path):
     tree = ast.parse("import jax\n"
                      "s = jax.local_devices()[0].memory_stats()\n")

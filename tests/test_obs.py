@@ -220,6 +220,36 @@ def test_metrics_prometheus_format():
         st.metrics(fmt="xml")
 
 
+def test_prometheus_help_type_and_hostile_label_roundtrip():
+    """Exposition-format conformance (ISSUE 9 satellite): # HELP /
+    # TYPE pairs, and label values escaped so a hostile tenant label
+    (quotes, backslash, newline) survives a parse round-trip."""
+    from spartan_tpu.obs.metrics import (REGISTRY, labeled,
+                                         parse_labels, split_labels)
+
+    hostile = 'hostile "corp"\\division\nnewline'
+    key = labeled("serve_requests", tenant=hostile)
+    REGISTRY.counter(key, "requests submitted to the serve "
+                     "engine").inc(2)
+    text = st.metrics(fmt="prometheus")
+    assert "# HELP spartan_serve_requests" in text
+    assert "# TYPE spartan_serve_requests counter" in text
+    # exactly one physical line carries the hostile series: the raw
+    # newline was escaped, not emitted
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("spartan_serve_requests{")
+             and "division" in ln]
+    assert len(lines) == 1
+    series = lines[0].rsplit(" ", 1)[0]
+    assert "\n" not in series
+    # round-trip: parsing the rendered series recovers the raw label
+    _base, labels = parse_labels(series)
+    assert labels["tenant"] == hostile
+    # the canonical instrument key parses back to the same value too
+    assert parse_labels(key)[1]["tenant"] == hostile
+    assert split_labels(key)[0] == "serve_requests"
+
+
 def test_metrics_plan_cache_view_matches_shims():
     x = st.from_numpy(np.ones((8, 8), np.float32))
     (st.as_expr(x) + 1.0).evaluate()

@@ -178,6 +178,86 @@ def test_unroll_mode_and_demotion_ladder():
     assert coalesce.mode_for(plan) == "off"
 
 
+def test_request_id_propagation_through_coalesce():
+    """ISSUE-9 satellite: a coalesced batch of N submissions yields N
+    flight records sharing ONE dispatch span id, bit-equal results,
+    and a per-request latency decomposition that adds up."""
+    from spartan_tpu.obs import flight
+
+    xe, ye = _shared(seed=9)
+
+    def build(i):
+        return (xe + ye).sum() * float(i + 1)
+
+    serial = [np.asarray(build(i).evaluate().glom()) for i in range(8)]
+    flight.clear()
+    with st.ServeEngine(workers=1, batch_window_s=0.05,
+                        max_batch=8) as eng:
+        futs = [eng.submit(build(i), tenant="rid-t") for i in range(8)]
+        served = [np.asarray(f.glom(timeout=60)) for f in futs]
+    for a, b in zip(serial, served):
+        np.testing.assert_array_equal(a, b)  # bit-equal to serial
+
+    rec = st.flightrec()
+    rids = [f.rid for f in futs]
+    assert len(set(rids)) == 8 and all(r > 0 for r in rids)
+    reqs = [rec["requests"][r] for r in rids]
+    # one coalesced dispatch resolved every request: N records, one
+    # shared span id, batch=8 on each
+    spans = {q["dispatch_span"] for q in reqs}
+    assert len(spans) == 1 and None not in spans
+    assert all(q["batch"] == 8 for q in reqs)
+    assert all(q["status"] == "ok" for q in reqs)
+    # the head request led the batch; the rest joined from the queue
+    # or the linger window — the recorded 'via' says which
+    vias = [q["via"] for q in reqs]
+    assert vias.count("head") == 1
+    assert set(vias) <= {"head", "queued", "window"}
+    # lifecycle events arrived in order for every request
+    for q in reqs:
+        ev = q["events"]
+        assert ev.index("submit") < ev.index("enqueue") \
+            < ev.index("coalesce") < ev.index("resolve") \
+            < ev.index("fetch")
+    # per-request decomposition: each phase non-negative, and the sum
+    # matches the future-stamped end-to-end latency
+    for f, q in zip(futs, reqs):
+        qw, cw, dw = (q["queue_wait_s"], q["coalesce_wait_s"],
+                      q["dispatch_s"])
+        assert qw >= 0 and cw >= 0 and dw >= 0
+        total = f.t_resolved - f.t_submit
+        # recorded phases are rounded to 1µs each: allow 3 roundings
+        assert abs((qw + cw + dw) - total) < 5e-6
+        assert q["fetch_s"] >= 0
+    # the tenant's decomposition histograms saw all 8 requests
+    tn = rec["tenants"]["rid-t"]
+    for phase in ("queue_wait", "coalesce_wait", "dispatch", "fetch"):
+        assert tn[phase]["count"] >= 8, (phase, tn)
+
+
+def test_flightrec_records_solo_and_shed():
+    from spartan_tpu.obs import flight
+
+    xe, ye = _shared(seed=10)
+    flight.clear()
+    eng = st.ServeEngine(workers=1, batch_window_s=0.0, max_batch=4)
+    # expired-deadline request sheds before dispatch (engine not yet
+    # started so it cannot be serviced early)
+    shed = eng.submit((xe * ye).sum(), deadline_s=0.0)
+    eng.start()
+    with pytest.raises(st.DeadlineExceeded):
+        shed.result(timeout=60)
+    solo = eng.submit((xe - ye).sum() * 3.0)
+    solo.result(timeout=60)
+    eng.stop()
+    rec = st.flightrec()
+    assert rec["requests"][shed.rid]["status"] == "shed"
+    assert rec["requests"][shed.rid]["reason"] == "deadline"
+    sq = rec["requests"][solo.rid]
+    assert sq["status"] == "ok" and sq["batch"] == 1
+    assert "dispatch" in sq["events"]
+
+
 def test_explain_names_coalesced_batch():
     xe, ye = _shared()
 

@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Eight repo-specific rules that generic linters cannot know:
+Nine repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -82,6 +82,17 @@ Eight repo-specific rules that generic linters cannot know:
    and the ``device_*`` gauges. Go through
    ``obs.metrics.device_memory_aggregate()``.
 
+9. No raw ``jax.profiler`` use and no direct ``.cost_analysis()`` /
+   ``.memory_analysis()`` calls outside ``obs/`` and
+   ``resilience/memory.py`` (the cost-ledger PR): every device-time
+   measurement and compiled-program introspection must flow through
+   the sanctioned entry points (``obs.trace.device_profile`` /
+   ``.annotate``, ``obs.explain.compiled_cost_analysis``,
+   ``resilience.memory.validate_plan``) so the reading lands in the
+   cost ledger next to the model's prediction — a stray profiler
+   capture or cost read-out produces numbers the calibration loop
+   never sees and cannot be compared against the committed gates.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -145,6 +156,17 @@ _MEMSTATS_ALLOWED_FILES = {
     os.path.join("spartan_tpu", "parallel", "mesh.py"),
     os.path.join("spartan_tpu", "resilience", "memory.py"),
 }
+
+# rule 9: device-time instrumentation single-sourcing — raw
+# jax.profiler use and compiled cost/memory introspection live in the
+# observability layer (+ the memory governor, whose validate_plan is
+# the one memory_analysis consumer), so every reading can land in the
+# cost ledger
+_PROFILING_ALLOWED_DIRS = (os.path.join("spartan_tpu", "obs") + os.sep,)
+_PROFILING_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "resilience", "memory.py"),
+}
+_ANALYSIS_CALLS = {"cost_analysis", "memory_analysis"}
 
 # rule 7: mesh constructors whose results must not live in module
 # globals / class attributes outside the owning package — a captured
@@ -478,6 +500,52 @@ def lint_raw_memory_stats(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_raw_profiling(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 9: no raw jax.profiler use and no direct cost_analysis /
+    memory_analysis calls outside obs/ + resilience/memory.py — a
+    measurement that bypasses the sanctioned entry points never
+    reaches the cost ledger, so it can't be compared against the
+    models it should be validating."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _PROFILING_ALLOWED_FILES or any(
+            rel.startswith(d) for d in _PROFILING_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "raw-profiling",
+            f"{what}: device-time measurement and compiled-program "
+            "introspection are single-sourced so readings land in the "
+            "cost ledger — use obs.trace.device_profile/.annotate, "
+            "obs.explain.compiled_cost_analysis, or "
+            "resilience.memory.validate_plan"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "profiler":
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "jax":
+                flag(node, "raw jax.profiler use")
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.profiler"):
+                flag(node, f"import from {mod!r}")
+            elif mod == "jax" and any(a.name == "profiler"
+                                      for a in node.names):
+                flag(node, "binds jax.profiler directly")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.profiler"):
+                    flag(node, f"import {a.name}")
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ANALYSIS_CALLS):
+            flag(node, f"direct .{node.func.attr}() call")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -565,6 +633,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_shared_state(path, tree))
         findings.extend(lint_mesh_capture(path, tree))
         findings.extend(lint_raw_memory_stats(path, tree))
+        findings.extend(lint_raw_profiling(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
