@@ -33,6 +33,7 @@ from ..obs import ledger as ledger_mod
 from ..obs import numerics as numerics_mod
 from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
+from ..parallel import redistribute as redistribute_mod
 from ..resilience import degrade as degrade_mod
 from ..resilience import faults as faults_mod
 from ..resilience import memory as memory_mod
@@ -171,10 +172,20 @@ class Expr:
             # otherwise, and lower() only runs on plan-cache misses
             numerics_mod.probe(self, val)
             if self._forced_tiling is not None:
-                # smart-tiling chose this node's layout: constrain it so
-                # GSPMD materializes the planned resharding points
-                val = jax.lax.with_sharding_constraint(
-                    val, self._forced_tiling.sharding(mesh_mod.get_mesh()))
+                # smart-tiling chose this node's layout: constrain it
+                # so GSPMD materializes the planned resharding points.
+                # Through the redistribution seam (parallel/
+                # redistribute.constrain): under
+                # FLAGS.redistribution_planner, edges where the cost
+                # model predicts an explicit collective schedule beats
+                # GSPMD's generic lowering are emitted explicitly (the
+                # node's natural layout is the source the DP priced
+                # this edge from); everything else — planner off, no
+                # predicted win, indivisible shapes — stays a plain
+                # with_sharding_constraint.
+                val = redistribute_mod.constrain(
+                    val, self._forced_tiling, mesh_mod.get_mesh(),
+                    src=self._default_tiling())
             env[self._id] = val
         return env[self._id]
 
@@ -1057,12 +1068,16 @@ def _opt_flags_key() -> Tuple:
         # invalidates this memo)
         cal = ((FLAGS.cost_calibration_fingerprint or "on")
                if FLAGS.cost_calibration else None)
+        # the redistribution planner changes BOTH the DP's edge costs
+        # and the emitted lowering (explicit schedules vs GSPMD), so
+        # planned and implicit plans must never alias
         key = (tuple(p.name for p in _PASSES if p.enabled()),
                FLAGS.opt_fold_slices, FLAGS.placement,
                FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
                FLAGS.tiling_operand_move_weight,
                FLAGS.tiling_memory_weight,
-               bool(FLAGS.audit_numerics), cal)
+               bool(FLAGS.audit_numerics), cal,
+               bool(FLAGS.redistribution_planner))
         _opt_key_memo = (ver, key)
     return key + (getattr(degrade_mod._TLS, "rung", None),)
 
@@ -1421,10 +1436,13 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
     # the mesh component leads with the epoch (elastic recovery): a
     # plan compiled for a dead mesh must never alias a post-rebuild
     # executable of the same structure, and evict_stale_plans reaps
-    # old-epoch entries by this element
+    # old-epoch entries by this element. The redistribution-planner
+    # flag is keyed like audit: a planner-on trace emits explicit
+    # collective schedules where the planner-off trace emits
+    # with_sharding_constraint, for the same structural signature.
     key = (root_sig, tuple(t.axes for t in out_tilings),
            (mesh_mod._EPOCH,) + tuple(sorted(mesh.shape.items())),
-           audit, degrade_rung)
+           audit, degrade_rung, redistribute_mod.planner_on())
 
     leaf_ids = tuple(l._id for l in leaves)
     out_shardings = tuple(t.sharding(mesh) for t in out_tilings)

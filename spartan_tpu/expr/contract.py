@@ -22,13 +22,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
 from ..parallel import mesh as mesh_mod
+from ..parallel import redistribute as redist_mod
 from .base import Expr
 
 _CANON = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
@@ -142,8 +142,12 @@ class ContractExpr(Expr):
             mesh = mesh_mod.get_mesh()
             out_t, strategy = self._dot_plan
             ta, tb = self.plan_operand_tilings(out_t, strategy)
-            av = jax.lax.with_sharding_constraint(av, ta.sharding(mesh))
-            bv = jax.lax.with_sharding_constraint(bv, tb.sharding(mesh))
+            # planned reshard edges ride the redistribution seam: the
+            # DP priced them from the children's committed tilings
+            av = redist_mod.constrain(av, ta, mesh,
+                                      src=self.a.out_tiling())
+            bv = redist_mod.constrain(bv, tb, mesh,
+                                      src=self.b.out_tiling())
         return jnp.einsum(self._subscripts(), av, bv,
                           precision=self.precision)
 

@@ -21,6 +21,7 @@ import numpy as np
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
 from ..parallel import mesh as mesh_mod
+from ..parallel import redistribute as redist_mod
 from ..parallel.mesh import AXIS_COL, AXIS_ROW
 from .base import Expr, as_expr
 
@@ -81,10 +82,14 @@ class DotExpr(Expr):
             # (tiles computed where they live).
             plan_t, k = self._dot_plan
             m_r, m_c = plan_t.axes[:2]
-            av = jax.lax.with_sharding_constraint(
-                av, Tiling((m_r, k)).sharding(mesh))
-            bv = jax.lax.with_sharding_constraint(
-                bv, Tiling((k, m_c)).sharding(mesh))
+            # operand reshard edges go through the redistribution seam
+            # (src = the committed child tiling the DP priced this
+            # edge from): explicit collective schedules where the
+            # planner predicts a win, with_sharding_constraint else
+            av = redist_mod.constrain(av, Tiling((m_r, k)), mesh,
+                                      src=self.a.out_tiling())
+            bv = redist_mod.constrain(bv, Tiling((k, m_c)), mesh,
+                                      src=self.b.out_tiling())
         return jnp.dot(av, bv, precision=self.precision)
 
     def _sig(self, ctx) -> Tuple:
@@ -143,8 +148,10 @@ class DotShardMapExpr(Expr):
         bv = self.b.lower(env)
         a_t = tiling_mod.Tiling((AXIS_ROW, AXIS_COL))
         b_t = tiling_mod.Tiling((AXIS_COL, None))
-        av = jax.lax.with_sharding_constraint(av, a_t.sharding(mesh))
-        bv = jax.lax.with_sharding_constraint(bv, b_t.sharding(mesh))
+        av = redist_mod.constrain(av, a_t, mesh,
+                                  src=self.a.out_tiling())
+        bv = redist_mod.constrain(bv, b_t, mesh,
+                                  src=self.b.out_tiling())
 
         def kernel(ab, bb):
             partial = jnp.dot(ab, bb)

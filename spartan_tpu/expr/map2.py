@@ -100,7 +100,7 @@ class ShardMap2Expr(Expr):
                              self._out_tiling, self._shape, self._dtype)
 
     def _lower(self, env: Dict[int, Any]) -> Any:
-        import jax
+        from ..parallel import redistribute as redist_mod
         from ..utils.compat import shard_map
 
         mesh = mesh_mod.get_mesh()
@@ -108,9 +108,10 @@ class ShardMap2Expr(Expr):
         for c, t in zip(self.inputs, self.in_tilings):
             v = c.lower(env)
             # constrain operand layout so the kernel sees the blocks the
-            # caller named (resharding collective if needed)
-            v = jax.lax.with_sharding_constraint(
-                v, t.sharding(mesh))
+            # caller named (resharding collective if needed) — via the
+            # redistribution seam, planned when the child layout is
+            # known and the model predicts an explicit win
+            v = redist_mod.constrain(v, t, mesh, src=c.out_tiling())
             vals.append(v)
         mapped = shard_map(
             self.fn, mesh=mesh,

@@ -28,6 +28,7 @@ import numpy as np
 from ..array import tiling as tiling_mod
 from ..array.tiling import Tiling
 from ..parallel import mesh as mesh_mod
+from ..parallel import redistribute as redist_mod
 from .base import Expr, ScalarExpr, TupleExpr, ValExpr
 from .map import MapExpr
 from .reduce import GeneralReduceExpr, ReduceExpr
@@ -324,9 +325,27 @@ def _build_table(root: Expr, mesh) -> Dict:
     reshard_f = cal.get("reshard", 1.0) if cal else 1.0
     psum_f = cal.get("psum", 1.0) if cal else 1.0
     flop_f = cal.get("contraction", 1.0) if cal else 1.0
+    # redistribution planner (parallel/redistribute): edges priced by
+    # the modeled collective schedule (per-collective calibrated
+    # factors applied INSIDE edge_cost, clamped at the receive-bytes
+    # floor) instead of the raw receive-bytes heuristic. The flag is
+    # part of _opt_flags_key, so planned and heuristic plans never
+    # alias; when on, the per-edge factor weight moves inside the
+    # planner (move_unit 1.0) and the psum term is calibrated by its
+    # reduce-scatter + all-gather halves, matching class_components.
+    planner = redist_mod.planner_on()
+    move_unit = 1.0 if planner else reshard_f
+    if planner and cal:
+        psum_f = 0.5 * (cal.get("reduce_scatter", 1.0)
+                        + cal.get("all_gather", 1.0))
 
     def nbytes(e: Expr) -> float:
         return float(e.size) * e.dtype.itemsize
+
+    def move_cost(tc: Tiling, req: Tiling, nb: float) -> float:
+        if planner:
+            return redist_mod.edge_cost(tc, req, nb, mesh, cal)
+        return reshard_cost(tc, req, nb, mesh)
 
     def best_child(c: Expr, req: Optional[Tiling], w: float = 1.0
                    ) -> Tuple[float, Optional[Tiling], float]:
@@ -340,7 +359,7 @@ def _build_table(root: Expr, mesh) -> Dict:
         best_move = 0.0
         for tc, entry in table[c._id].items():
             move = (0.0 if req is None
-                    else reshard_cost(tc, req, nbytes(c), mesh))
+                    else move_cost(tc, req, nbytes(c)))
             total = entry[0] + w * move
             # on a total tie prefer the lower-move entry, so the move
             # fed into the _OP_MOVE_EPS tie-break is itself
@@ -385,9 +404,9 @@ def _build_table(root: Expr, mesh) -> Dict:
                 for s in strategies:
                     req_a, req_b = reqs_fn(t, s)
                     ca, pa, ma = best_child(kids[0], req_a,
-                                            move_w * reshard_f)
+                                            move_w * move_unit)
                     cb, pb, mb = best_child(kids[1], req_b,
-                                            move_w * reshard_f)
+                                            move_w * move_unit)
                     psum = 0.0
                     if s is not None:
                         # ring all-reduce of each chip's PARTIAL — the
@@ -414,7 +433,7 @@ def _build_table(root: Expr, mesh) -> Dict:
             picks: List[Tiling] = []
             for i, c in enumerate(kids):
                 req = _operand_requirement(node, t, c, i)
-                ccost, pick, _ = best_child(c, req, reshard_f)
+                ccost, pick, _ = best_child(c, req, move_unit)
                 comm += ccost
                 picks.append(pick)
             entries[t] = (comm + compute + memcost, tuple(picks), None)
@@ -533,21 +552,41 @@ def class_components(root: Expr, mesh=None) -> Dict[str, float]:
     weight = _compute_weight()
     flop_w = _flop_weight()
     move_w = _operand_move_weight()
+    # planner on: reshard edges decompose into their chosen schedule's
+    # per-collective bytes (all_gather / all_to_all) and psum into its
+    # reduce-scatter + all-gather halves, so fit_profile calibrates
+    # each collective's factor independently (obs/ledger.CLASSES)
+    planner = redist_mod.planner_on()
     comp: Dict[str, float] = {}
 
     def add(cls: str, v: float) -> None:
         if v:
             comp[cls] = comp.get(cls, 0.0) + float(v)
 
-    def move(child: Expr, req: Optional[Tiling], w: float) -> float:
+    def move(child: Expr, req: Optional[Tiling], w: float) -> None:
         if req is None:
-            return 0.0
+            return
         try:
             src = child.out_tiling()
         except Exception:
-            return 0.0
+            return
         nb = float(child.size) * child.dtype.itemsize
-        return w * reshard_cost(src, req, nb, mesh)
+        if planner:
+            for cls, v in redist_mod.edge_components(src, req, nb,
+                                                     mesh).items():
+                add(cls, w * v)
+            return
+        add("reshard", w * reshard_cost(src, req, nb, mesh))
+
+    def add_psum(v: float) -> None:
+        if planner:
+            # a ring all-reduce is reduce-scatter + all-gather of the
+            # shard — split the modeled bytes so each half calibrates
+            # under its own collective class
+            add("reduce_scatter", 0.5 * v)
+            add("all_gather", 0.5 * v)
+        else:
+            add("psum", v)
 
     for n in dag_nodes(root):
         if isinstance(n, (ValExpr, ScalarExpr)):
@@ -568,14 +607,14 @@ def class_components(root: Expr, mesh=None) -> Dict[str, float]:
                 / (par * _axis_size(mesh, s)))
             if s is not None:
                 ns = _axis_size(mesh, s)
-                add("psum", 2.0 * nbytes / par * (ns - 1) / ns)
+                add_psum(2.0 * nbytes / par * (ns - 1) / ns)
             try:
                 reqs = reqs_fn(grid, s)
             except Exception:
                 reqs = None
             if reqs is not None:
                 for c, req in zip(kids, reqs):
-                    add("reshard", move(c, req, move_w))
+                    move(c, req, move_w)
             continue
         add(op_class(n), nbytes * weight / _parallelism(t, mesh))
         for i, c in enumerate(kids):
@@ -583,7 +622,7 @@ def class_components(root: Expr, mesh=None) -> Dict[str, float]:
                 req = _operand_requirement(n, t, c, i)
             except Exception:
                 req = None
-            add("reshard", move(c, req, 1.0))
+            move(c, req, 1.0)
     return {k: round(v, 3) for k, v in comp.items()}
 
 

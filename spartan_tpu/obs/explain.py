@@ -120,12 +120,20 @@ def _tiling_entries(dag: Any) -> List[Dict[str, Any]]:
 def _reshard_edges(dag: Any) -> List[Dict[str, Any]]:
     """Edges where the plan demands an operand layout different from
     the child's own output layout — the points a resharding collective
-    (all-gather / all-to-all) must materialize."""
+    (all-gather / all-to-all) must materialize. With
+    ``FLAGS.redistribution_planner`` on, each edge also names its
+    CHOSEN collective schedule, the modeled cost, and whether the
+    explicit lowering or the GSPMD fallback was taken — the A/B is
+    readable from one ``st.explain`` call."""
     from ..expr import tiling_cost
     from ..expr.optimize import dag_nodes
     from ..parallel import mesh as mesh_mod
+    from ..parallel import redistribute as redist_mod
+    from . import ledger as ledger_mod
 
     mesh = mesh_mod.get_mesh()
+    planner = redist_mod.planner_on()
+    factors = ledger_mod.factors() if planner else None
     edges = []
     for n in dag_nodes(dag):
         kids = n.children()
@@ -166,12 +174,29 @@ def _reshard_edges(dag: Any) -> List[Dict[str, Any]]:
                 moved = None
             if moved == 0.0:
                 continue  # e.g. replicated source: no wire traffic
-            edges.append({
+            entry = {
                 "edge": f"{_label(c)} -> {_label(n)}", "operand": i,
                 "src": src, "dst": req.axes,
                 "bytes_per_chip": (round(moved, 1)
                                    if moved is not None else None),
-            })
+            }
+            if planner:
+                # the SAME decision the lowering seam makes for this
+                # edge (redistribute.constrain) — schedule, modeled
+                # cost and explicit-vs-GSPMD path
+                try:
+                    d = redist_mod.decide(c.out_tiling(), req,
+                                          c.shape, c.dtype, mesh,
+                                          factors)
+                except Exception:
+                    d = None
+                if d is not None:
+                    entry["schedule"] = d.schedule.describe()
+                    entry["modeled_cost"] = round(d.cost, 1)
+                    entry["path"] = ("explicit" if d.explicit
+                                     else "gspmd")
+                    entry["reason"] = d.reason
+            edges.append(entry)
     return edges
 
 
@@ -314,9 +339,14 @@ class ExplainReport:
         if d.get("reshard_edges"):
             lines.append("  reshard edges:")
             for e in d["reshard_edges"]:
-                lines.append(
-                    f"    {e['edge']}: {e['src']} -> {e['dst']} "
-                    f"(~{e['bytes_per_chip']} B/chip)")
+                line = (f"    {e['edge']}: {e['src']} -> {e['dst']} "
+                        f"(~{e['bytes_per_chip']} B/chip)")
+                if e.get("schedule"):
+                    # planned edge: chosen schedule, modeled cost, and
+                    # which path the lowering took (the one-call A/B)
+                    line += (f" via {e['schedule']} [{e['path']}, "
+                             f"cost~{e['modeled_cost']}]")
+                lines.append(line)
         if d.get("leaves") is not None:
             lines.append(f"  leaves: {len(d['leaves'])} "
                          f"(arg order {d.get('arg_order')})")

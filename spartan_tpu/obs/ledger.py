@@ -99,9 +99,16 @@ _MODELS = ("tiling_dp", "peak_hbm", "service_time")
 # the op-class vocabulary shared with expr/tiling_cost: node-class
 # factors scale the compute term of that node class; "contraction"
 # scales the FLOP term, "reshard" the operand-move bytes, "psum" the
-# output all-reduce bytes
+# output all-reduce bytes. Under FLAGS.redistribution_planner the
+# edge classes decompose per collective — "all_gather"/"all_to_all"
+# from each reshard edge's chosen schedule (parallel/redistribute),
+# "reduce_scatter"+"all_gather" from the psum term's two halves — so
+# fit_profile calibrates each collective's factor independently from
+# measured dispatches and the planner's schedule prices improve with
+# use (profile fingerprint keying handles plan separation).
 CLASSES = ("map", "reduce", "transpose", "slice", "other",
-           "contraction", "reshard", "psum")
+           "contraction", "reshard", "psum",
+           "all_gather", "all_to_all", "reduce_scatter")
 
 
 class _Entry:

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..array import tiling as tiling_mod
 from ..parallel import mesh as mesh_mod
+from ..parallel import redistribute as redist_mod
 
 _LOCAL = {
     "add": jnp.cumsum,
@@ -97,7 +98,7 @@ def blocked_scan(x: jax.Array, op: str = "add", mesh=None,
     t = tiling_mod.sanitize(t, x.shape, mesh)
     if t.mesh_axis_of(0) is None:  # sanitize dropped the scan axis
         return _LOCAL[op](x, axis=0)
-    x = jax.lax.with_sharding_constraint(x, t.sharding(mesh))
+    x = redist_mod.constrain(x, t, mesh)
     mapped = shard_map(lambda v: _kernel(v, axis, p, op), mesh=mesh,
                        in_specs=(t.spec(),), out_specs=t.spec())
     return mapped(x)

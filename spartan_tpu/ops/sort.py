@@ -46,6 +46,7 @@ import numpy as np
 
 from ..array import tiling as tiling_mod
 from ..parallel import mesh as mesh_mod
+from ..parallel import redistribute as redist_mod
 
 _SAMPLES = 64  # per-shard splitter samples (capped at shard size)
 
@@ -252,7 +253,7 @@ def _run(x: jax.Array, mesh, with_indices: bool,
     xp, m = _padded(x, n, p)
     batch = batch_axes(in_tiling, name, x.ndim)
     t = tiling_mod.Tiling(batch + (name,))
-    xp = jax.lax.with_sharding_constraint(xp, t.sharding(mesh))
+    xp = redist_mod.constrain(xp, t, mesh)
     s = min(_SAMPLES, m)
     # payload-only exchanges where the backend has the ragged thunk;
     # the vmapped (batched) path keeps the padded transport (no
@@ -345,7 +346,7 @@ def distributed_topk(x: jax.Array, k: int, largest: bool = True,
         raise ValueError(
             f"distributed_topk requires k <= shard size {m}; got {k}")
     row = tiling_mod.row(1)
-    xp = jax.lax.with_sharding_constraint(xp, row.sharding(mesh))
+    xp = redist_mod.constrain(xp, row, mesh)
     sentinel = _extreme(x.dtype, lo=largest)
 
     def kern(xs):

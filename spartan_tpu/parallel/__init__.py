@@ -1,1 +1,1 @@
-from . import collectives, mesh, pipeline  # noqa: F401
+from . import collectives, mesh, pipeline, redistribute  # noqa: F401

@@ -166,10 +166,16 @@ def _staging_bytes(child: Any, req, mesh) -> float:
     """Destination-shard bytes a reshard edge materializes: the same
     per-axis layout fractions as ``tiling_cost.reshard_cost`` (zero
     when no wire traffic moves — same layout, or replicated source
-    already covering the destination)."""
+    already covering the destination). Under
+    ``FLAGS.redistribution_planner`` the edge has a CHOSEN collective
+    schedule, so staging is the schedule's actual peak intermediate
+    (``redistribute.staging_frac``) — e.g. a gather-then-slice route
+    stages the gathered axis, an all_to_all route only its final
+    shard — instead of the destination-shard approximation."""
     import numpy as np
 
     from ..expr.tiling_cost import reshard_cost
+    from ..parallel import redistribute as redist_mod
 
     try:
         src = child.out_tiling()
@@ -180,6 +186,10 @@ def _staging_bytes(child: Any, req, mesh) -> float:
     nbytes = float(child.size) * np.dtype(child.dtype).itemsize
     if reshard_cost(src, req, nbytes, mesh) <= 0.0:
         return 0.0  # e.g. replicated source: shards carved locally
+    if redist_mod.planner_on():
+        frac = redist_mod.staging_frac(src, req, mesh)
+        if frac is not None:
+            return frac * nbytes
     return _shard_bytes(child.shape, child.dtype, req, mesh)
 
 

@@ -322,3 +322,34 @@ def test_raw_memory_stats_allowed_in_owners(tmp_path):
     # attribute reads that are not calls (docs, strings) are NOT flagged
     other = ast.parse("name = 'memory_stats'\nx = obj.memory_stats\n")
     assert lint_repo.lint_raw_memory_stats("/x/y.py", other) == []
+
+
+def test_catches_raw_sharding_constraint(tmp_path):
+    bad = tmp_path / "bad_wsc.py"
+    bad.write_text(
+        "import jax\n"
+        "from jax.lax import with_sharding_constraint\n"
+        "x = jax.lax.with_sharding_constraint(x, s)\n"
+        "y = with_sharding_constraint(y, s)\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_sharding_constraints(str(bad), tree)
+    # the import binding + the attribute call (the bare Name call is
+    # covered by the import-binding finding at its source)
+    assert sum(f.rule == "raw-sharding-constraint"
+               for f in findings) == 2
+    assert all("redistribute.constrain" in f.message for f in findings)
+
+
+def test_raw_sharding_constraint_allowed_in_owners():
+    tree = ast.parse(
+        "import jax\n"
+        "v = jax.lax.with_sharding_constraint(v, t.sharding(mesh))\n")
+    for rel in (os.path.join("spartan_tpu", "parallel",
+                             "redistribute.py"),
+                os.path.join("spartan_tpu", "expr", "base.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_sharding_constraints(path, tree) == []
+    # unrelated attributes and plain name mentions are NOT flagged
+    other = ast.parse("name = 'with_sharding_constraint'\n"
+                      "fn = redistribute.constrain\n")
+    assert lint_repo.lint_sharding_constraints("/x/y.py", other) == []

@@ -182,3 +182,85 @@ def test_auto_tiling_ablation_changes_plan(mesh2d):
     assert dots and all(d._dot_plan is not None for d in dots)
     np.testing.assert_allclose(np.asarray(e_on.glom()), off, rtol=1e-4)
     np.testing.assert_allclose(off, (a @ a).T, rtol=1e-4)
+
+
+# -- redistribution-planner edge pricing (ISSUE 10) ----------------------
+
+
+def _vocab(mesh):
+    return (tiling.replicated(2), tiling.row(2), tiling.col(2),
+            tiling.block(2), tiling.row_t(2), tiling.col_t(2),
+            tiling.block_t(2))
+
+
+def test_reshard_cost_replicated_roundtrips(mesh2d):
+    """replicated <-> row/col/block in BOTH directions: carving a
+    replicated source is free; re-replicating a sharded layout pays
+    the all-gather fraction."""
+    m = mesh_mod.get_mesh()
+    rep = tiling.replicated(2)
+    for dst in (tiling.row(2), tiling.col(2), tiling.block(2)):
+        assert reshard_cost(rep, dst, 1024, m) == 0.0  # local carve
+        back = reshard_cost(dst, rep, 1024, m)
+        n = 1
+        for s in dst.tiles_per_dim(m):
+            n *= s
+        assert back == pytest.approx(1024 * (n - 1) / n)
+
+
+def test_edge_cost_monotone_above_receive_floor(mesh2d):
+    """Schedule-vs-heuristic monotonicity: the planner's modeled edge
+    cost is NEVER below the receive-bytes floor (the minimum a correct
+    redistribution must deliver), for every vocabulary pair."""
+    from spartan_tpu.parallel import redistribute as rd
+
+    m = mesh_mod.get_mesh()
+    for src in _vocab(m):
+        for dst in _vocab(m):
+            ec = rd.edge_cost(src, dst, 4096.0, m)
+            assert ec >= reshard_cost(src, dst, 4096.0, m) - 1e-9
+
+
+def test_edge_cost_tuple_axes_fall_back(mesh2d):
+    """Tuple-sharded mesh axes (flat_row) are outside the step
+    vocabulary: no schedules, edge cost falls back to the heuristic."""
+    from spartan_tpu.parallel import redistribute as rd
+
+    m = mesh_mod.get_mesh()
+    flat = tiling.flat_row(2)
+    row = tiling.row(2)
+    assert rd.schedules(flat, row, m) == ()
+    assert rd.edge_cost(flat, row, 4096.0, m) == pytest.approx(
+        reshard_cost(flat, row, 4096.0, m))
+    assert rd.edge_cost(row, flat, 4096.0, m) == pytest.approx(
+        reshard_cost(row, flat, 4096.0, m))
+
+
+def test_edge_cost_single_device_degenerate():
+    """1-device mesh: nothing moves, nothing is explicit."""
+    from spartan_tpu.parallel import redistribute as rd
+
+    m = mesh_mod.build_mesh(mesh_mod.jax.devices()[:1], shape=(1, 1))
+    with mesh_mod.use_mesh(m):
+        row, rep = tiling.row(2), tiling.replicated(2)
+        assert rd.edge_cost(row, rep, 1024.0, m) == 0.0
+        d = rd.decide(row, rep, (8, 8), np.float32, m)
+        assert d is None or not d.explicit
+
+
+def test_planner_flag_changes_dp_edge_prices(mesh2d):
+    """The DP's edge pricing is schedule-modeled under the flag: a
+    block -> block_t style transition prices at the cheaper collective
+    route, not the gather-everything heuristic's upper bound — and
+    with the flag off the legacy heuristic is untouched."""
+    from spartan_tpu.parallel import redistribute as rd
+
+    m = mesh_mod.get_mesh()
+    src, dst = tiling.row(2), tiling.col_t(2)  # ('x',None)->(None,'x')
+    heur = reshard_cost(src, dst, 4096.0, m)
+    planned = rd.edge_cost(src, dst, 4096.0, m)
+    # the all_to_all schedule achieves exactly the receive floor here
+    assert planned == pytest.approx(heur)
+    sched = rd.schedules(src, dst, m)
+    assert any(s.steps[0].kind == "all_to_all" and len(s.steps) == 1
+               for s in sched)

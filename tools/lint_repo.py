@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Nine repo-specific rules that generic linters cannot know:
+Ten repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -93,6 +93,18 @@ Nine repo-specific rules that generic linters cannot know:
    capture or cost read-out produces numbers the calibration loop
    never sees and cannot be compared against the committed gates.
 
+10. No raw ``jax.lax.with_sharding_constraint`` outside
+    ``parallel/redistribute.py`` and ``expr/base.py`` (the
+    redistribution-planner PR): every sharding-constraint call site is
+    a reshard edge the cost-modeled planner must see — a raw
+    constraint is invisible to the planner (its edge is never priced,
+    never eligible for the explicit collective lowering, and absent
+    from ``st.explain``'s schedule report). Go through
+    ``parallel.redistribute.constrain()`` (pass ``src=`` when the
+    producing layout is known so the edge is plannable); the two
+    allowed files are the planner itself and the ``Expr.lower`` /
+    jit-output seam that defines the fallback.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -167,6 +179,15 @@ _PROFILING_ALLOWED_FILES = {
     os.path.join("spartan_tpu", "resilience", "memory.py"),
 }
 _ANALYSIS_CALLS = {"cost_analysis", "memory_analysis"}
+
+# rule 10: the only places allowed to call with_sharding_constraint
+# directly — the redistribution planner (which decides explicit
+# schedule vs GSPMD fallback per edge) and the expr/base lowering seam
+# that routes through it
+_WSC_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "parallel", "redistribute.py"),
+    os.path.join("spartan_tpu", "expr", "base.py"),
+}
 
 # rule 7: mesh constructors whose results must not live in module
 # globals / class attributes outside the owning package — a captured
@@ -546,6 +567,39 @@ def lint_raw_profiling(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_sharding_constraints(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 10: no raw ``with_sharding_constraint`` outside the
+    redistribution planner and the expr/base lowering seam — a raw
+    constraint is a reshard edge the cost-modeled planner never sees
+    (not priced, never explicit, absent from st.explain's schedule
+    report)."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _WSC_ALLOWED_FILES:
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "raw-sharding-constraint",
+            f"{what}: sharding-constraint seams belong to the "
+            "redistribution planner — call "
+            "parallel.redistribute.constrain() (pass src= when the "
+            "producing layout is known) so the edge is priced, "
+            "eligible for the explicit collective lowering, and "
+            "visible in st.explain's schedule report"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "with_sharding_constraint":
+            flag(node, "raw with_sharding_constraint use")
+        elif isinstance(node, ast.ImportFrom):
+            if any(a.name == "with_sharding_constraint"
+                   or a.asname == "with_sharding_constraint"
+                   for a in node.names):
+                flag(node, "binds with_sharding_constraint directly")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -634,6 +688,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_mesh_capture(path, tree))
         findings.extend(lint_raw_memory_stats(path, tree))
         findings.extend(lint_raw_profiling(path, tree))
+        findings.extend(lint_sharding_constraints(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
