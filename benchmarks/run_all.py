@@ -279,6 +279,18 @@ def redistribution_overhead(st):
                       ab_n=128 if SMALL else 256)
 
 
+def profile_overhead(st):
+    """Device-time attribution gates (benchmarks/profile_overhead.py):
+    the sampler's off-path toll on the steady-state hit path (<=1% is
+    the ISSUE-11 gate: one flag read per dispatch) plus the
+    sampled-on cost at profile_sample_every=4, reported unjudged (a
+    sampled dispatch pays for its attribution replay by design)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import profile_overhead as po
+
+    return po.measure(iters=60, n=512 if SMALL else 4096)
+
+
 def serving_overhead(st):
     """Serving-engine gates (benchmarks/serving_latency.py): 16-client
     coalesced throughput vs a serial evaluate() loop (>=3x is the
@@ -371,6 +383,9 @@ def guard_metrics(report) -> dict:
         "redist_off_overhead_ratio":
             report["redistribution_overhead"].get(
                 "redist_off_overhead_ratio"),
+        "profile_off_overhead_ratio":
+            report["profile_overhead"].get(
+                "profile_off_overhead_ratio"),
     }
 
 
@@ -401,6 +416,7 @@ def main():
         "calibration_overhead": _with_metrics(calibration_overhead, st),
         "redistribution_overhead": _with_metrics(
             redistribution_overhead, st),
+        "profile_overhead": _with_metrics(profile_overhead, st),
     }
     # full flag state once at report level (the per-record
     # flags_nondefault deltas are diffs against these defaults)
@@ -437,7 +453,8 @@ def main():
                  "elastic_off_overhead_ratio": 0.01,
                  "memgov_off_overhead_ratio": 0.01,
                  "calibration_off_overhead_ratio": 0.01,
-                 "redist_off_overhead_ratio": 0.01}
+                 "redist_off_overhead_ratio": 0.01,
+                 "profile_off_overhead_ratio": 0.01}
         # fixed FLOORS (ISSUE gates on ratios that must stay high):
         # coalescing must amortize dispatch >=3x across 16 clients
         fixed_min = {"serve_coalesced_speedup": 3.0}

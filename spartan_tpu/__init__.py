@@ -32,10 +32,11 @@ from .parallel.mesh import (StaleMeshError, build_mesh, get_mesh,
 from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import check, lint
 from . import obs
-from .obs import (AuditReport, CalibrationProfile, ExplainReport,
-                  Watchpoint, audit, explain, fit_profile, load_profile,
-                  loop_health, metrics, save_profile, trace_clear,
-                  trace_events, trace_export, unwatch, watch)
+from .obs import (AuditReport, CalibrationProfile, DeviceProfile,
+                  ExplainReport, Watchpoint, audit, explain,
+                  fit_profile, load_profile, loop_health, metrics,
+                  save_profile, trace_clear, trace_events,
+                  trace_export, unwatch, watch)
 from . import resilience
 from .resilience import ChaosPlan, FatalMeshError, chaos, chaos_clear
 from . import serve
@@ -57,6 +58,7 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "trace_events", "trace_clear",
             "ledger", "flightrec", "CalibrationProfile", "fit_profile",
             "save_profile", "load_profile",
+            "profile", "profile_export", "DeviceProfile",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
             "loop_health",
             "resilience", "chaos", "chaos_clear", "ChaosPlan",
@@ -107,6 +109,26 @@ def flightrec(limit=None):
     per-request timelines, and per-tenant latency-decomposition
     histograms for the serve path."""
     return obs.flightrec(limit=limit)
+
+
+def profile(expr, tier=None, reps=None):
+    """Device-time attribution (docs/OBSERVABILITY.md): run one
+    profiled evaluation of ``expr`` and return per-expr-node device
+    seconds keyed by each node's structural-signature digest, with
+    measured time next to the tiling DP's modeled cost. ``tier``:
+    'auto' (default) tries the XPlane/trace-parse capture and falls
+    back to the portable segmented replay; 'xplane' / 'replay' force
+    one. Continuous sampling in production:
+    ``FLAGS.profile_sample_every = N``."""
+    return obs.profile.profile(expr, tier=tier, reps=reps)
+
+
+def profile_export(path=None, profile=None):
+    """One Perfetto-loadable Chrome trace merging the host span ring
+    (``st.trace_export``'s content) with a device timeline — the given
+    :class:`DeviceProfile`, else the most recent one (st.profile or a
+    sampled dispatch). See docs/OBSERVABILITY.md."""
+    return obs.profile.export_merged(path, profile=profile)
 
 
 def shutdown():

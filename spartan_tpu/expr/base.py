@@ -31,6 +31,7 @@ from ..array.distarray import DistArray
 from ..array.tiling import Tiling
 from ..obs import ledger as ledger_mod
 from ..obs import numerics as numerics_mod
+from ..obs import profile as profile_mod
 from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
 from ..parallel import redistribute as redistribute_mod
@@ -145,9 +146,11 @@ class Expr:
             try:
                 if FLAGS.trace_annotations:
                     # trace-time-only: device profiles (Perfetto /
-                    # TensorBoard) attribute XLA ops back to this node
-                    with jax.named_scope(
-                            f"{type(self).__name__}_{self._id}"):
+                    # TensorBoard) attribute XLA ops back to this node;
+                    # inside _build_plan's naming session the scope
+                    # also carries the node's _sig digest — the join
+                    # key st.profile's trace-parse tier matches on
+                    with jax.named_scope(profile_mod.scope_name(self)):
                         val = self._lower(env)
                 else:
                     val = self._lower(env)
@@ -1222,14 +1225,6 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
                 # dispatch; donation there is bookkeeping-only
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
-            if FLAGS.profile:
-                # device-profile capture via the ONE sanctioned
-                # jax.profiler entry point (obs/trace, lint rule 9)
-                with prof.device_profile(FLAGS.profile_dir):
-                    with launch_guard():
-                        o = ex.jitted(*args)
-                    jax.block_until_ready(o)
-                return o
             with launch_guard():
                 return ex.jitted(*args)
 
@@ -1258,6 +1253,15 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         # plan's predicted tiling-DP cost (one flag read when off)
         ledger_mod.note_dispatch(plan.report.get("plan_key"),
                                  phase_name, phase_ctx.seconds)
+    if profile_mod._SAMPLE_FLAG._value > 0:
+        # sampled continuous profiling (obs/profile.py): every Nth
+        # warm dispatch of a plan gets a device-time attribution, off
+        # the result path — the served result above came from the
+        # unmodified executable (bit-equal to unsampled). The legacy
+        # FLAGS.profile whole-dispatch capture migrated here: one
+        # profiling entry point, one flag read per dispatch when off.
+        profile_mod.maybe_sample(expr, plan, phase_name,
+                                 phase_ctx.seconds, leaves, dpos, mesh)
 
     if FLAGS.check_determinism and not dpos:  # a donated arg is gone
         out2 = run()
@@ -1449,16 +1453,22 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
 
     def traced(*args: Any) -> Any:
         env: Dict[int, Any] = dict(zip(leaf_ids, args))
-        if audit:
-            # probe session: leaves first (a poisoned input names the
-            # LEAF, not its first consumer), then every node as
-            # Expr.lower emits it — attach order is topological
-            with numerics_mod.probe_session():
-                for leaf, arg in zip(leaves, args):
-                    numerics_mod.probe(leaf, arg, kind="leaf")
+        # naming session (obs/profile.py): every named_scope emitted
+        # under this trace carries the node's _sig digest, so device
+        # profiler captures of THIS executable join back to expr nodes
+        # (one memoized signing traversal; trace time only; no-op when
+        # FLAGS.trace_annotations is off)
+        with profile_mod.naming_session():
+            if audit:
+                # probe session: leaves first (a poisoned input names
+                # the LEAF, not its first consumer), then every node as
+                # Expr.lower emits it — attach order is topological
+                with numerics_mod.probe_session():
+                    for leaf, arg in zip(leaves, args):
+                        numerics_mod.probe(leaf, arg, kind="leaf")
+                    out = dag.lower(env)
+            else:
                 out = dag.lower(env)
-        else:
-            out = dag.lower(env)
         # a constraint (not jit out_shardings) so GSPMD propagation can
         # negotiate ops like reverse that hard-fail on output overrides
         if is_tuple:

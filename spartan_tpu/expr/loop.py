@@ -161,7 +161,7 @@ class LoopExpr(Expr):
                 jax.debug.callback(
                     functools.partial(obs_trace.record_loop_step,
                                       label), i)
-            with jax.named_scope("st_loop_body"):
+            with obs_trace.named_scope("st_loop_body"):
                 return tuple(b.lower(benv) for b in self.body_roots)
 
         def health_of(i: Any, old: Tuple[Any, ...],

@@ -20,6 +20,12 @@ FLAGS-gated cProfile dumps — SURVEY.md §5):
   order, donation slots, and ``cost_analysis()`` FLOPs for the plan —
   instant for plan-cache hits (the report is built once, on the miss
   path).
+* :mod:`profile` — device-time attribution: ``st.profile(expr)``
+  (per-expr-node device seconds keyed by ``_sig`` digest; XPlane
+  trace-parse tier with a portable segmented-replay fallback),
+  sampled continuous profiling (``FLAGS.profile_sample_every``) that
+  feeds the ledger's device columns, and ``st.profile_export(path)``
+  merging host spans + the device timeline into one Perfetto trace.
 * :mod:`numerics` — the data-health sentinel: ``st.audit(expr)``
   (device-side per-node health words with first-bad-node attribution
   under ``FLAGS.audit_numerics``), ``st.watch(distarray)`` persistent
@@ -38,10 +44,12 @@ from . import flight
 from . import ledger as _ledger_mod
 from . import metrics as _metrics_mod
 from . import numerics
+from . import profile
 from . import trace as _trace_mod
 from .explain import ExplainReport, explain
 from .ledger import (CalibrationProfile, fit_profile, load_profile,
                      save_profile)
+from .profile import DeviceProfile
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
 from .numerics import (AuditReport, Watchpoint, audit, dump_crash,
                        loop_health, unwatch, watch, watchpoints)
@@ -65,4 +73,4 @@ __all__ = ["span", "Span", "trace_export", "trace_events", "trace_clear",
            "Watchpoint", "loop_health", "dump_crash",
            "ledger", "ledger_snapshot", "flight", "flightrec",
            "CalibrationProfile", "fit_profile", "save_profile",
-           "load_profile"]
+           "load_profile", "profile", "DeviceProfile"]

@@ -282,7 +282,10 @@ class ExplainReport:
     ``.data`` is the raw dict; the common fields are attributes:
     ``cache`` ('hit' / 'miss' / 'evaluated'), ``plan_key``,
     ``passes``, ``tilings``, ``reshard_edges``, ``leaves``,
-    ``arg_order``, ``donation``, ``cost_analysis``, ``flops``.
+    ``arg_order``, ``donation``, ``cost_analysis``, ``flops``, and —
+    once ``st.profile`` or the ``FLAGS.profile_sample_every`` sampler
+    has measured this plan — ``device_profile`` (per-node measured
+    device seconds next to the modeled costs, hottest first).
     """
 
     def __init__(self, data: Dict[str, Any]):
@@ -347,6 +350,31 @@ class ExplainReport:
                     line += (f" via {e['schedule']} [{e['path']}, "
                              f"cost~{e['modeled_cost']}]")
                 lines.append(line)
+        dp = d.get("device_profile")
+        if dp:
+            # measured device time (obs/profile.py: st.profile or the
+            # FLAGS.profile_sample_every sampler) next to the modeled
+            # cost, hottest nodes first — the measured counterpart of
+            # the tilings section's cost estimates
+            lines.append(
+                f"  device profile [{dp.get('tier')}]: wall "
+                f"{dp.get('wall_s', 0.0) * 1e3:.3f}ms, attributed "
+                f"{dp.get('attributed_fraction', 0.0) * 100:.1f}% "
+                f"(unattributed "
+                f"{dp.get('unattributed_s', 0.0) * 1e3:.3f}ms)")
+            nodes = dp.get("nodes") or []
+            shown = nodes if len(nodes) <= 8 else nodes[:5]
+            for n in shown:
+                modeled = (f" modeled~{n['modeled_cost']}"
+                           if n.get("modeled_cost") is not None else "")
+                lines.append(
+                    f"    {n['node']:<24} "
+                    f"{n['seconds'] * 1e3:9.3f}ms "
+                    f"{n.get('share', 0.0) * 100:5.1f}%"
+                    f"{modeled}")
+            if len(nodes) > len(shown):
+                lines.append(f"    ... ({len(nodes) - len(shown)} "
+                             "more attributed node(s))")
         if d.get("leaves") is not None:
             lines.append(f"  leaves: {len(d['leaves'])} "
                          f"(arg order {d.get('arg_order')})")

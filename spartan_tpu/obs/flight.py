@@ -27,6 +27,10 @@ Event grammar (``kind`` + fields; all optional fields flat):
   / ``dispatch_s``
 * ``fetch``     — ``seconds`` the caller's ``glom`` blocked on device
   execution + transfer
+* ``profiled``  — this request's dispatch was sampled by the
+  device-time attribution profiler (``FLAGS.profile_sample_every``,
+  obs/profile.py): ``plan``, ``tier`` ('xplane' | 'replay'),
+  ``device_s`` (attributed device seconds), ``attributed_fraction``
 
 The decomposition also feeds per-tenant histograms
 (``serve_queue_wait_s{tenant=...}`` etc. in ``st.metrics()``), so
@@ -199,6 +203,12 @@ def snapshot(limit: Optional[int] = None) -> Dict[str, Any]:
                 req[k] = args.get(k)
         elif ev.kind == "fetch":
             req["fetch_s"] = args.get("seconds")
+        elif ev.kind == "profiled":
+            req["profiled"] = {
+                "tier": args.get("tier"),
+                "device_s": args.get("device_s"),
+                "attributed_fraction": args.get("attributed_fraction"),
+            }
         elif ev.kind in ("reject", "shed", "drain", "fallback"):
             req["status"] = ev.kind
             if args.get("reason"):

@@ -96,6 +96,11 @@ FLAGS.define_str(
 
 _MODELS = ("tiling_dp", "peak_hbm", "service_time")
 
+# calibration-profile file schema (st.save_profile/st.load_profile):
+# v2 added the device-time provenance fields (meta.source,
+# meta.device_rows); v1 files still load with host-wall defaults
+PROFILE_VERSION = 2
+
 # the op-class vocabulary shared with expr/tiling_cost: node-class
 # factors scale the compute term of that node class; "contraction"
 # scales the FLOP term, "reshard" the operand-move bytes, "psum" the
@@ -119,7 +124,10 @@ class _Entry:
                  "xla_bytes_accessed", "pred_mem_bytes_validated",
                  "xla_peak_bytes", "dispatch_count", "dispatch_total_s",
                  "dispatch_min_s", "compile_s", "service_count",
-                 "service_total_s", "pred_service_total_s")
+                 "service_total_s", "pred_service_total_s",
+                 "device_samples", "device_wall_total_s",
+                 "device_attr_total_s", "device_components",
+                 "device_tier")
 
     def __init__(self, digest: str):
         self.digest = digest
@@ -139,6 +147,15 @@ class _Entry:
         self.service_count = 0
         self.service_total_s = 0.0
         self.pred_service_total_s = 0.0
+        # DEVICE columns (obs/profile.py sampled attribution): per-op-
+        # class device seconds measured by st.profile / the sampler —
+        # fit_profile calibrates from these when present, host wall
+        # otherwise
+        self.device_samples = 0
+        self.device_wall_total_s = 0.0
+        self.device_attr_total_s = 0.0
+        self.device_components: Optional[Dict[str, float]] = None
+        self.device_tier: Optional[str] = None
 
 
 _lock = threading.Lock()
@@ -278,6 +295,30 @@ def note_cost_analysis(digest: Optional[str],
             pass
 
 
+def note_device_profile(digest: Optional[str], tier: str,
+                        wall_s: float, attributed_s: float,
+                        class_seconds: Dict[str, float]) -> None:
+    """``obs/profile``'s hook: one device-time attribution sample —
+    whole-plan wall, attributed device seconds, and the per-op-class
+    decomposition. Accumulated into the entry's DEVICE columns, which
+    :func:`fit_profile` prefers over host dispatch wall: the factors
+    then correct each class from where the device actually spent time
+    instead of one blended total."""
+    if not _LEDGER_FLAG._value or digest is None:
+        return
+    with _lock:
+        e = _get_or_create(digest)
+        e.device_samples += 1
+        e.device_wall_total_s += max(0.0, wall_s)
+        e.device_attr_total_s += max(0.0, attributed_s)
+        e.device_tier = tier
+        comp = e.device_components or {}
+        for k, v in (class_seconds or {}).items():
+            if v > 0:
+                comp[k] = comp.get(k, 0.0) + float(v)
+        e.device_components = comp or None
+
+
 def ingest(digest: str, components: Dict[str, float],
            measured_s: float, dp_cost: Optional[float] = None) -> None:
     """Offline entry point: feed an externally measured schedule (a
@@ -378,6 +419,17 @@ def snapshot(validate: bool = False) -> Dict[str, Any]:
                 "service_mean_s": (
                     round(e.service_total_s / e.service_count, 6)
                     if e.service_count else None),
+                "device": ({
+                    "samples": e.device_samples,
+                    "tier": e.device_tier,
+                    "wall_mean_s": round(
+                        e.device_wall_total_s / e.device_samples, 9),
+                    "attributed_mean_s": round(
+                        e.device_attr_total_s / e.device_samples, 9),
+                    "class_seconds_mean": {
+                        k: round(v / e.device_samples, 9)
+                        for k, v in (e.device_components or {}).items()},
+                } if e.device_samples else None),
             },
             "ratios": ratios,
         }
@@ -438,9 +490,17 @@ class CalibrationProfile:
     reshapes the model's trade-offs, not its absolute scale). File
     format (``st.save_profile`` / ``st.load_profile``)::
 
-        {"version": 1,
+        {"version": 2,
          "factors": {"reshard": 4.1, "psum": 0.8, ...},
-         "meta": {"fitted_from_plans": 12, "platform": "cpu", ...}}
+         "meta": {"fitted_from_plans": 12, "platform": "cpu",
+                  "source": "device_time" | "host_wall",
+                  "device_rows": 8, ...}}
+
+    Version history: v1 profiles predate the device-time columns
+    (``meta.source`` / ``meta.device_rows``); :meth:`from_dict` still
+    accepts them, defaulting ``source`` to ``"host_wall"`` — the only
+    measurement v1 fits could have used. Writers emit
+    :data:`PROFILE_VERSION`.
     """
 
     def __init__(self, factors: Dict[str, float],
@@ -448,6 +508,7 @@ class CalibrationProfile:
         self.factors = {str(k): float(v) for k, v in factors.items()
                         if float(v) > 0}
         self.meta = dict(meta or {})
+        self.meta.setdefault("source", "host_wall")
 
     def fingerprint(self) -> str:
         """Stable short digest of the factor set — keyed into
@@ -459,17 +520,27 @@ class CalibrationProfile:
         return hashlib.sha1(blob.encode()).hexdigest()[:12]
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"version": 1, "factors": dict(self.factors),
+        return {"version": PROFILE_VERSION,
+                "factors": dict(self.factors),
                 "meta": dict(self.meta),
                 "fingerprint": self.fingerprint()}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "CalibrationProfile":
-        if int(d.get("version", 1)) != 1:
+        version = int(d.get("version", 1))
+        if not 1 <= version <= PROFILE_VERSION:
             raise ValueError(
                 f"unsupported calibration profile version "
-                f"{d.get('version')!r}")
-        return cls(d.get("factors") or {}, d.get("meta"))
+                f"{d.get('version')!r} (this build reads 1.."
+                f"{PROFILE_VERSION})")
+        meta = dict(d.get("meta") or {})
+        if version < 2:
+            # pre-device-column profiles could only have been fitted
+            # from host wall; default the v2 fields so downstream
+            # readers see one schema
+            meta.setdefault("source", "host_wall")
+            meta.setdefault("device_rows", 0)
+        return cls(d.get("factors") or {}, meta)
 
     def __repr__(self) -> str:
         body = ", ".join(f"{k}={v:.3g}"
@@ -507,18 +578,33 @@ def factors() -> Optional[Dict[str, float]]:
 def fit_profile(min_dispatches: int = 1) -> Optional[CalibrationProfile]:
     """Least-squares per-op-class factors from the ledger.
 
-    Each entry with a component decomposition and a measured dispatch
-    time contributes one row ``sum_c comp[c] * f_c ~= measured_s``;
-    the solution is clipped positive and normalized so the total
-    modeled cost over the fit set is unchanged (factors are relative).
-    Returns None when the ledger holds nothing fittable."""
+    Entries carrying DEVICE columns (sampled attribution,
+    ``obs/profile``) contribute one row PER CLASS — the predicted
+    component against the class's measured device seconds, so each
+    factor is determined by where the device actually spent time.
+    Entries with only host measurements contribute the classic total
+    row ``sum_c comp[c] * f_c ~= dispatch_min_s``. The solution is
+    clipped positive and normalized so the total modeled cost over the
+    fit set is unchanged (factors are relative). Returns None when the
+    ledger holds nothing fittable."""
     import numpy as np
 
+    rows: List[Tuple[Dict[str, float], float]] = []
+    device_rows = 0
     with _lock:
-        rows = [(dict(e.components), e.dispatch_min_s)
-                for e in _entries.values()
-                if e.components and e.dispatch_min_s
-                and e.dispatch_count >= min_dispatches]
+        for e in _entries.values():
+            if not e.components:
+                continue
+            if e.device_samples and e.device_components:
+                n = e.device_samples
+                for c, secs in e.device_components.items():
+                    pc = e.components.get(c, 0.0)
+                    if pc > 0 and secs > 0:
+                        rows.append(({c: pc}, secs / n))
+                        device_rows += 1
+                continue
+            if e.dispatch_min_s and e.dispatch_count >= min_dispatches:
+                rows.append((dict(e.components), e.dispatch_min_s))
     if not rows:
         return None
     classes = sorted({c for comp, _ in rows for c in comp
@@ -541,7 +627,9 @@ def fit_profile(min_dispatches: int = 1) -> Optional[CalibrationProfile]:
     f = np.clip(sol * (base / denom), 0.01, 100.0)
     factors_ = {c: float(f[i]) for i, c in enumerate(classes)}
     return CalibrationProfile(factors_, meta={
-        "fitted_from_plans": len(rows), "classes": classes})
+        "fitted_from_plans": len(rows), "classes": classes,
+        "source": ("device_time" if device_rows else "host_wall"),
+        "device_rows": device_rows})
 
 
 def save_profile(path: str,

@@ -1,0 +1,809 @@
+"""Device-time attribution profiler: per-expr-node device seconds.
+
+The rest of the obs stack measures at whole-plan host-wall granularity
+— the cost ledger compares the tiling DP's modeled cost against total
+dispatch wall, and ``st.explain`` shows modeled per-node costs with no
+measured counterpart. This module closes that gap: ``st.profile(expr)``
+runs one profiled evaluation and returns a per-expr-node DEVICE-time
+report keyed by each node's structural-signature digest (the same
+``_sig`` digest the numerics sentinel tags health words with, and the
+join key the ``jax.named_scope`` annotations now carry — see
+:func:`scope_name`). Two attribution tiers behind one API:
+
+* **xplane** — when the runtime exposes captured profiler data, one
+  whole-plan run is wrapped in the sanctioned
+  ``obs.trace.device_profile`` capture (lint rule 9) and the emitted
+  trace files are parsed: device events whose names carry a
+  ``__sg_<digest>`` named-scope marker are summed per node. Real
+  concurrent-schedule timings, zero re-execution.
+* **replay** — the portable fallback (exact and dependency-free on the
+  CPU CI path): each node's sub-plan is jitted and its dispatch timed
+  with ``block_until_ready``; a node's attributed time is its sub-plan
+  time minus its (unique) children's sub-plan times, clipped at zero.
+  The increments telescope to the whole-plan wall, so attribution
+  covers >=90% of the measured wall on the CPU matrix with the
+  residual reported as ``unattributed``.
+
+``tier="auto"`` (the default) tries the capture first and falls back
+to replay when the runtime yields no (or only partial) device events.
+
+**Sampled continuous profiling.** ``FLAGS.profile_sample_every=N``
+profiles every Nth warm dispatch of a plan — a dispatch-TIME wrapper
+only: no plan/compile-key changes, the served result comes from the
+unmodified executable (sampled results are bit-equal to unsampled),
+and the attribution runs off the result path after the real dispatch.
+Sampled timelines fold per-node device seconds into the cost ledger as
+per-op-class DEVICE columns (``fit_profile`` then calibrates from
+device time instead of host wall), stamp the sampled request in the
+flight recorder (``profiled`` event), and land on the plan report so
+``st.explain`` shows measured device time next to the modeled cost,
+with a top-k hottest-nodes view. The OFF path (N=0, the default) costs
+one flag read per dispatch (``benchmarks/profile_overhead.py`` gates
+it at <=1%).
+
+``st.profile_export(path)`` merges the host span ring and the last
+device timeline into one Perfetto-loadable Chrome trace (the device
+track is an attribution layout — segments laid end-to-end in execution
+order — not a literal device schedule for the replay tier).
+
+Import discipline: sits in ``obs`` (config/trace/metrics/ledger/
+explain above it only); expr-layer types are reached lazily inside
+functions, so ``expr/base`` can bind this module at import time.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import shutil
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.config import FLAGS
+from . import ledger as ledger_mod
+from . import trace as trace_mod
+from .explain import key_hash
+from .metrics import METRICS_FLAG as _METRICS_FLAG
+from .metrics import REGISTRY
+
+# define() returns the Flag; expr/base._dispatch reads ._value directly
+# (ONE attribute load per dispatch is the whole off-path cost —
+# benchmarks/profile_overhead.py gate).
+_SAMPLE_FLAG = FLAGS.define_int(
+    "profile_sample_every", 0,
+    "Sampled continuous profiling: profile every Nth warm dispatch of "
+    "each plan (per-plan counters) with the device-time attribution "
+    "profiler, folding per-node device seconds into the cost ledger's "
+    "device columns, the plan report (st.explain) and the flight "
+    "recorder. 0 = off (the default; one flag read per dispatch). "
+    "Sampling is a dispatch-time wrapper only — no plan/compile-key "
+    "changes, sampled results bit-equal to unsampled.")
+_TIER_FLAG = FLAGS.define_str(
+    "profile_tier", "auto",
+    "Attribution tier for st.profile and the sampler: 'auto' (try the "
+    "XPlane/trace-parse capture, fall back to segmented replay), "
+    "'xplane' (capture only; raises when the runtime exposes no "
+    "parsable device trace), 'replay' (portable segmented replay — "
+    "exact and dependency-free on CPU).")
+_MAX_NODES_FLAG = FLAGS.define_int(
+    "profile_max_nodes", 128,
+    "Replay-tier node budget: DAGs with more interior nodes than this "
+    "profile only the first (topological) budget's worth of sub-plans "
+    "and report the rest in the unattributed residual "
+    "(nodes_skipped on the report).")
+
+_SCOPE_MARK = "__sg_"
+_SCOPE_RX = re.compile(r"__sg_([0-9a-f]{4,16})")
+
+_lock = threading.Lock()
+_tls = threading.local()
+_sample_counts: Dict[str, int] = {}
+# plan digest -> _Attribution (the replay machinery is a per-plan
+# compile investment; continuous sampling reuses it across requests)
+_attr_cache: "OrderedDict[str, _Attribution]" = OrderedDict()
+_ATTR_CACHE_MAX = 16
+# jax.profiler supports one capture at a time; concurrent samplers
+# skip the xplane tier instead of racing it
+_capture_lock = threading.Lock()
+_last_profile: Optional["DeviceProfile"] = None
+
+
+# -- digest-carrying named scopes (trace time) ----------------------------
+#
+# PR 3 wrapped every node's kernel body in jax.named_scope(TypeName_id)
+# so device profiles map XLA ops back to expr nodes. The id is
+# process-transient, so it cannot JOIN a capture against a report built
+# from a different traversal; inside a naming session the scope gains
+# the node's structural-signature digest — stable across re-optimizes
+# of the same structure — as "TypeName_id__sg_<digest>".
+# expr/base._build_plan opens a session around every plan trace, so
+# every compiled executable carries the join key; the cost is one
+# memoized signing traversal per jit trace (trace time only).
+
+
+class _NamingCtx:
+    """Per-trace digest source: one shared, memoizing signature
+    context; a node's digest is the hash of its memoized signature
+    within the root traversal (the root's scope is entered first, so
+    one ``of(root)`` memoizes every descendant)."""
+
+    __slots__ = ("_sig", "_digests")
+
+    def __init__(self, sig_ctx: Any = None):
+        if sig_ctx is None:
+            from ..expr.base import _SigCtx  # lazy: obs sits below expr
+
+            sig_ctx = _SigCtx()
+        self._sig = sig_ctx
+        self._digests: Dict[int, str] = {}
+
+    def digest(self, node: Any) -> Optional[str]:
+        d = self._digests.get(node._id)
+        if d is None:
+            try:
+                memo = self._sig._memo
+                if node._id not in memo:
+                    self._sig.of(node)
+                d = key_hash(memo[node._id]) or ""
+            except Exception:  # noqa: BLE001 - naming is advisory
+                d = ""
+            self._digests[node._id] = d
+        return d or None
+
+
+class naming_session:
+    """Context manager installing a fresh :class:`_NamingCtx` for the
+    tracing thread (no-op when ``FLAGS.trace_annotations`` is off —
+    there are no scopes to name)."""
+
+    __slots__ = ("_prev", "_on")
+
+    def __enter__(self) -> "naming_session":
+        self._on = bool(FLAGS.trace_annotations)
+        if self._on:
+            self._prev = getattr(_tls, "naming", None)
+            _tls.naming = _NamingCtx()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._on:
+            _tls.naming = self._prev
+
+
+class _use_naming:
+    """Install an EXISTING naming ctx (the replay tier traces each
+    node's sub-plan under the attribution's shared ctx, so sub-plan
+    scopes carry the same digests as the production executable)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: _NamingCtx):
+        self._ctx = ctx
+
+    def __enter__(self) -> "_use_naming":
+        self._prev = getattr(_tls, "naming", None)
+        _tls.naming = self._ctx
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.naming = self._prev
+
+
+def scope_name(node: Any) -> str:
+    """The ``jax.named_scope`` label for one expr node —
+    ``TypeName_<id>`` plus, inside a naming session, the structural
+    ``__sg_<digest>`` join key the trace-parse tier matches on.
+    Called by ``Expr.lower`` at trace time only."""
+    base = f"{type(node).__name__}_{node._id}"
+    ctx = getattr(_tls, "naming", None)
+    if ctx is None:
+        return base
+    d = ctx.digest(node)
+    return f"{base}{_SCOPE_MARK}{d}" if d else base
+
+
+# -- the report object ----------------------------------------------------
+
+
+class DeviceProfile:
+    """One device-time attribution: per-node seconds keyed by ``_sig``
+    digest, plus the whole-plan wall and the unattributed residual.
+
+    ``nodes`` is a list of dicts sorted hottest-first, each carrying
+    ``node`` (label), ``digest``, ``op_class``, ``site``, ``shape``,
+    ``seconds`` (measured device time), ``share`` (of attributed) and
+    ``modeled_cost`` (the tiling DP's estimate for the same node —
+    measured next to modeled, per node)."""
+
+    def __init__(self, tier: str, plan_digest: Optional[str],
+                 wall_s: float, nodes: List[Dict[str, Any]],
+                 note: Optional[str] = None, nodes_skipped: int = 0):
+        self.tier = tier
+        self.plan_digest = plan_digest
+        self.wall_s = float(max(0.0, wall_s))
+        self.nodes = sorted(nodes, key=lambda n: -n["seconds"])
+        self.note = note
+        self.nodes_skipped = int(nodes_skipped)
+        self.t0_us = (trace_mod.now() - trace_mod.epoch()) * 1e6
+
+    @property
+    def attributed_s(self) -> float:
+        return float(sum(n["seconds"] for n in self.nodes))
+
+    @property
+    def unattributed_s(self) -> float:
+        return max(0.0, self.wall_s - self.attributed_s)
+
+    @property
+    def attributed_fraction(self) -> float:
+        if self.wall_s <= 0:
+            return 0.0
+        return min(1.0, self.attributed_s / self.wall_s)
+
+    def top(self, k: int = 5) -> List[Dict[str, Any]]:
+        """The k hottest attributed nodes (measured device seconds,
+        descending)."""
+        return self.nodes[:max(0, k)]
+
+    def class_seconds(self) -> Dict[str, float]:
+        """Attributed device seconds summed per cost-model op class —
+        the vector the ledger's device columns accumulate."""
+        out: Dict[str, float] = {}
+        for n in self.nodes:
+            c = n.get("op_class") or "other"
+            out[c] = out.get(c, 0.0) + n["seconds"]
+        return {k: round(v, 9) for k, v in out.items()}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "tier": self.tier,
+            "plan": self.plan_digest,
+            "wall_s": round(self.wall_s, 9),
+            "attributed_s": round(self.attributed_s, 9),
+            "unattributed_s": round(self.unattributed_s, 9),
+            "attributed_fraction": round(self.attributed_fraction, 4),
+            "class_seconds": self.class_seconds(),
+            "nodes": [dict(n) for n in self.nodes],
+            "nodes_skipped": self.nodes_skipped,
+            "note": self.note,
+        }
+
+    # stored on the plan report under "device_profile" so a cache-hit
+    # st.explain renders measured-vs-modeled without re-profiling
+    to_report = to_dict
+
+    def trace_events(self) -> List[Dict[str, Any]]:
+        """Chrome trace events for the merged export: one synthetic
+        device track (tid 1000000) with the attributed segments laid
+        end-to-end in execution (topological) order, anchored at the
+        profile's capture time, plus the unattributed residual."""
+        pid = os.getpid()
+        tid = 1_000_000
+        evts: List[Dict[str, Any]] = [{
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": f"device timeline (st.profile, "
+                             f"{self.tier} tier)"},
+        }]
+        cursor = self.t0_us
+        for n in sorted(self.nodes, key=lambda d: d.get("topo", 0)):
+            dur = n["seconds"] * 1e6
+            evts.append({
+                "name": f"{n['node']} [{n['digest']}]", "ph": "X",
+                "ts": round(cursor, 3), "dur": round(dur, 3),
+                "pid": pid, "tid": tid,
+                "args": {"op_class": n.get("op_class"),
+                         "modeled_cost": n.get("modeled_cost"),
+                         "share": n.get("share")},
+            })
+            cursor += dur
+        if self.unattributed_s > 0:
+            evts.append({
+                "name": "(unattributed)", "ph": "X",
+                "ts": round(cursor, 3),
+                "dur": round(self.unattributed_s * 1e6, 3),
+                "pid": pid, "tid": tid, "args": {},
+            })
+        return evts
+
+    def __str__(self) -> str:
+        lines = [
+            f"device profile [{self.tier}] plan {self.plan_digest}: "
+            f"wall {self.wall_s * 1e3:.3f}ms, attributed "
+            f"{self.attributed_fraction * 100:.1f}% "
+            f"({len(self.nodes)} node(s), unattributed "
+            f"{self.unattributed_s * 1e3:.3f}ms)"]
+        if self.note:
+            lines.append(f"  note: {self.note}")
+        show = self.nodes if len(self.nodes) <= 8 else self.top(5)
+        for n in show:
+            modeled = (f" modeled~{n['modeled_cost']}"
+                       if n.get("modeled_cost") is not None else "")
+            lines.append(
+                f"  {n['node']:<24} {n['seconds'] * 1e3:9.3f}ms "
+                f"{n['share'] * 100:5.1f}%  [{n.get('op_class')}]"
+                f"{modeled}  sig={n['digest']}")
+        if len(self.nodes) > len(show):
+            lines.append(f"  ... ({len(self.nodes) - len(show)} more; "
+                         ".nodes has all)")
+        if self.nodes_skipped:
+            lines.append(f"  ({self.nodes_skipped} node(s) past "
+                         "FLAGS.profile_max_nodes not replayed)")
+        return "\n".join(lines)
+
+    __repr__ = __str__
+
+
+# -- attribution machinery ------------------------------------------------
+
+
+class _Attribution:
+    """The per-plan replay/parse machinery: the optimized DAG, its
+    leaves, the raw->optimized argument order, per-node digests and
+    lazily-jitted sub-plans. Built once per plan digest (bounded LRU)
+    and reused across samples — the optimizer run and the sub-plan
+    compiles are the investment, re-timing them is cheap."""
+
+    __slots__ = ("empty", "dag", "leaves", "leaf_ids", "arg_order",
+                 "naming", "nodes", "meta", "_jits", "_jit_lock")
+
+    def __init__(self, root: Any, mesh: Any):
+        from ..expr import base, tiling_cost
+        from ..expr.optimize import dag_nodes, optimize
+
+        rctx = base._PlanSigCtx()
+        rctx.of(root)
+        raw_leaves = rctx.leaves
+        dag = optimize(root)
+        self.empty = dag._result is not None
+        if self.empty:
+            return
+        ctx = base._SigCtx()
+        ctx.of(dag)
+        self.dag = dag
+        self.leaves = ctx.leaves
+        self.leaf_ids = tuple(l._id for l in self.leaves)
+        # maps each optimized-leaf position to the raw-leaf position
+        # feeding it — structurally identical roots produce identical
+        # orders, so a cached attribution replays with the CURRENT
+        # request's buffers, never the buffers it was built from
+        self.arg_order = base._arg_order(raw_leaves, self.leaves)
+        self.naming = _NamingCtx(ctx)
+        self.nodes: List[Any] = []
+        self.meta: Dict[int, Dict[str, Any]] = {}
+        for topo, n in enumerate(dag_nodes(dag)):
+            if isinstance(n, (base.ValExpr, base.ScalarExpr)):
+                continue
+            cost = getattr(n, "_plan_cost", None)
+            site = n._site
+            self.nodes.append(n)
+            self.meta[n._id] = {
+                "node": f"{type(n).__name__}#{n._id}",
+                "digest": self.naming.digest(n),
+                "op_class": tiling_cost.op_class(n),
+                "site": (f"{site[0]}:{site[1]}" if site else None),
+                "shape": list(n.shape),
+                "topo": topo,
+                "modeled_cost": (round(float(cost), 3)
+                                 if cost is not None else None),
+            }
+        self._jits: Dict[int, Any] = {}
+        self._jit_lock = threading.Lock()
+
+    def args_from_raw(self, raw_leaves: Optional[List[Any]]) -> List[Any]:
+        """Executable arguments for the sub-plans, gathered from the
+        CURRENT request's raw leaves via the recorded order (falling
+        back to this attribution's own leaves when no mapping holds)."""
+        from ..expr import base
+
+        order = self.arg_order
+        if (order is not None and raw_leaves is not None
+                and all(i < len(raw_leaves) for i in order)):
+            try:
+                return [base._leaf_arg(raw_leaves[i]) for i in order]
+            except TypeError:
+                pass  # e.g. a donated leaf: fall back to our own
+        return [base._leaf_arg(l) for l in self.leaves]
+
+    def node_fn(self, node: Any) -> Any:
+        """Jitted sub-plan computing ``node`` from the leaves, traced
+        under the shared naming ctx so its scopes carry the same
+        digests as the production executable."""
+        jf = self._jits.get(node._id)
+        if jf is None:
+            import jax
+
+            leaf_ids = self.leaf_ids
+            naming = self.naming
+
+            def fn(*args: Any) -> Any:
+                env = dict(zip(leaf_ids, args))
+                with _use_naming(naming):
+                    return node.lower(env)
+
+            with self._jit_lock:
+                jf = self._jits.setdefault(node._id, jax.jit(fn))
+        return jf
+
+
+def _attribution_for(digest: Optional[str], root: Any,
+                     mesh: Any) -> Optional[_Attribution]:
+    if digest is not None:
+        with _lock:
+            hit = _attr_cache.get(digest)
+            if hit is not None:
+                _attr_cache.move_to_end(digest)
+                return hit
+    attr = _Attribution(root, mesh)
+    if digest is not None:
+        with _lock:
+            attr = _attr_cache.setdefault(digest, attr)
+            _attr_cache.move_to_end(digest)
+            while len(_attr_cache) > _ATTR_CACHE_MAX:
+                _attr_cache.popitem(last=False)
+    return attr
+
+
+def _run_blocked(fn: Any, args: List[Any]) -> None:
+    """One guarded launch + a blocking fetch (XLA:CPU collectives
+    deadlock under concurrent launches — same guard as _dispatch)."""
+    import jax
+
+    from ..expr import base
+
+    with base.launch_guard():
+        out = fn(*args)
+    jax.block_until_ready(out)
+
+
+def _time_call(fn: Any, args: List[Any], reps: int) -> float:
+    """Best-of-``reps`` wall seconds of one warm, fetch-forced call."""
+    _run_blocked(fn, args)  # warm: trace + compile out of the timing
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = trace_mod.now()
+        _run_blocked(fn, args)
+        best = min(best, trace_mod.now() - t0)
+    return best
+
+
+def _replay_times(attr: _Attribution, args: List[Any], reps: int
+                  ) -> Tuple[Dict[int, float], float, int]:
+    """Segmented replay: per-node attributed seconds (sub-plan time
+    minus unique children's sub-plan times, clipped at zero), the
+    root's whole-plan time, and how many nodes were skipped (budget,
+    or un-replayable standalone — e.g. a loop body's interior nodes,
+    whose carry leaves only exist inside the loop; their time rolls
+    into the enclosing node's increment)."""
+    budget = max(8, _MAX_NODES_FLAG._value)
+    nodes = attr.nodes
+    skipped = max(0, len(nodes) - budget)
+    if skipped:
+        # keep the (topologically last) roots so the telescoped total
+        # still covers the whole plan; drop the earliest interiors
+        nodes = nodes[skipped:]
+    sub: Dict[int, float] = {}
+    for n in nodes:
+        try:
+            sub[n._id] = _time_call(attr.node_fn(n), args, reps)
+        except Exception:  # noqa: BLE001 - a sub-plan that cannot
+            # trace/dispatch standalone is not attributable; its time
+            # stays with the nearest replayable ancestor
+            skipped += 1
+    t_root = sub.get(attr.dag._id, max(sub.values()) if sub else 0.0)
+    inc: Dict[int, float] = {}
+    for n in nodes:
+        if n._id not in sub:
+            continue
+        kids = {c._id for c in n.children() if c._id in sub}
+        inc[n._id] = max(0.0, sub[n._id]
+                         - sum(sub[k] for k in kids))
+    return inc, t_root, skipped
+
+
+def _parse_trace_dir(root_dir: str) -> Optional[Dict[str, float]]:
+    """Sum device-event durations per ``__sg_`` digest across every
+    trace-event JSON the capture wrote. None when nothing parsable
+    (or nothing digest-tagged) was found."""
+    events: List[Dict[str, Any]] = []
+    for dirpath, _dirs, files in os.walk(root_dir):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            try:
+                if f.endswith(".trace.json.gz"):
+                    with gzip.open(p, "rt") as fh:
+                        doc = json.load(fh)
+                elif f.endswith(".trace.json"):
+                    with open(p) as fh:
+                        doc = json.load(fh)
+                else:
+                    continue
+            except (OSError, ValueError):
+                continue
+            events.extend(doc.get("traceEvents") or [])
+    if not events:
+        return None
+    # device tracks: process_name metadata naming a device stream;
+    # when the runtime labels nothing, fall back to every track (the
+    # auto tier's coverage check rejects a garbage parse)
+    device_pids = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = str((ev.get("args") or {}).get("name", "")).lower()
+            if any(k in name for k in ("/device:", "tpu", "gpu",
+                                       "stream", "xla")):
+                device_pids.add(ev.get("pid"))
+    out: Dict[str, float] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        if device_pids and ev.get("pid") not in device_pids:
+            continue
+        name = str(ev.get("name", ""))
+        m = _SCOPE_RX.search(name)
+        if m is None and ev.get("args"):
+            m = _SCOPE_RX.search(json.dumps(ev["args"]))
+        if m is None:
+            continue
+        out[m.group(1)] = out.get(m.group(1), 0.0) \
+            + float(ev.get("dur", 0.0)) / 1e6
+    return out or None
+
+
+def _xplane_times(attr: _Attribution, args: List[Any]
+                  ) -> Optional[Dict[int, float]]:
+    """Capture one whole-plan run under ``obs.trace.device_profile``
+    and attribute per-node seconds from the digest-tagged device
+    events. None when the capture is busy, fails, or yields nothing
+    joinable (the auto tier then falls back to replay)."""
+    if not _capture_lock.acquire(blocking=False):
+        return None
+    tmp = tempfile.mkdtemp(prefix="spartan_tpu_xplane_")
+    try:
+        fn = attr.node_fn(attr.dag)
+        _run_blocked(fn, args)  # warm OUTSIDE the capture
+        try:
+            with trace_mod.device_profile(tmp):
+                _run_blocked(fn, args)
+        except Exception:  # noqa: BLE001 - capture is best-effort
+            return None
+        by_digest = _parse_trace_dir(tmp)
+        if not by_digest:
+            return None
+        out: Dict[int, float] = {}
+        for n in attr.nodes:
+            d = attr.meta[n._id]["digest"]
+            if d is not None and d in by_digest:
+                out[n._id] = by_digest[d]
+        return out or None
+    finally:
+        _capture_lock.release()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _profile_impl(attr: _Attribution, args: List[Any], wall_s: float,
+                  tier: str, reps: int,
+                  digest: Optional[str]) -> DeviceProfile:
+    chosen = tier
+    node_secs: Optional[Dict[int, float]] = None
+    skipped = 0
+    if tier in ("auto", "xplane"):
+        node_secs = _xplane_times(attr, args)
+        if node_secs is not None:
+            chosen = "xplane"
+            att = sum(node_secs.values())
+            if tier == "auto" and (wall_s <= 0 or att < 0.5 * wall_s):
+                node_secs = None  # partial capture: replay is exact
+    if node_secs is None:
+        if tier == "xplane":
+            raise RuntimeError(
+                "profile tier 'xplane' requested but the runtime "
+                "exposed no parsable digest-tagged device trace "
+                "(obs.trace.device_profile capture yielded nothing "
+                "joinable); use tier='replay' or 'auto'")
+        node_secs, t_root, skipped = _replay_times(attr, args, reps)
+        chosen = "replay"
+        # the root's sub-plan IS the whole plan: its timing and the
+        # caller's wall are two measurements of the same program, and
+        # the smaller is the better device-wall estimate (a sampled
+        # dispatch's host wall also includes launch overhead)
+        wall_s = min(wall_s, t_root) if wall_s > 0 else t_root
+    nodes: List[Dict[str, Any]] = []
+    total = sum(node_secs.values()) or 1.0
+    for nid, secs in node_secs.items():
+        if secs <= 0:
+            continue
+        rec = dict(attr.meta[nid])
+        rec["seconds"] = round(secs, 9)
+        rec["share"] = round(secs / total, 4)
+        nodes.append(rec)
+    return DeviceProfile(chosen, digest, wall_s, nodes,
+                         nodes_skipped=skipped)
+
+
+def _record(prof: DeviceProfile, plan: Any) -> None:
+    """Fold one timeline into the surfaces that outlive it: the plan
+    report (st.explain), the cost ledger's device columns, the
+    metrics registry, and the merged-export anchor."""
+    global _last_profile
+
+    if plan is not None and plan.report is not None:
+        plan.report["device_profile"] = prof.to_report()
+        # make sure the plan's PREDICTIONS sit next to the device
+        # columns even when the entry was dropped (ledger reset /
+        # FIFO) after the plan was built — fit_profile needs both
+        ledger_mod.note_plan(plan)
+    ledger_mod.note_device_profile(
+        prof.plan_digest, prof.tier, prof.wall_s, prof.attributed_s,
+        prof.class_seconds())
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "profile_samples",
+            "device-time attribution profiles taken (st.profile + "
+            "sampled dispatches)").inc()
+        REGISTRY.gauge(
+            "profile_attributed_fraction",
+            "fraction of the last profiled whole-plan wall attributed "
+            "to named expr nodes").set(prof.attributed_fraction)
+    with _lock:
+        _last_profile = prof
+
+
+# -- the public API -------------------------------------------------------
+
+
+def profile(expr: Any, tier: Optional[str] = None,
+            reps: Optional[int] = None) -> DeviceProfile:
+    """Run one profiled evaluation of ``expr`` and return the
+    per-expr-node device-time report (see module docstring).
+
+    Plans like ``st.explain`` (a never-evaluated expr is pre-planned,
+    so the next ``evaluate()`` hits); an already-evaluated root is
+    re-planned from its lineage (children's cached results still
+    collapse). ``tier``: 'auto' (default, FLAGS.profile_tier) /
+    'xplane' / 'replay'; ``reps``: timing repetitions per sub-plan
+    (best-of, default 3)."""
+    from ..expr import base
+    from ..parallel import mesh as mesh_mod
+
+    root = expr if isinstance(expr, base.Expr) else base.as_expr(expr)
+    if type(root).__name__ == "DictExpr":
+        root = root._tuple
+    if root._result is not None and not isinstance(root, base.ValExpr):
+        # profile the computation, not the cached result; interior
+        # cached children still sign (and collapse) as leaves
+        root.invalidate()
+    mesh = mesh_mod.get_mesh()
+    tier = (tier or _TIER_FLAG._value or "auto").lower()
+    if tier not in ("auto", "xplane", "replay"):
+        raise ValueError(f"unknown profile tier {tier!r} "
+                         "(auto|xplane|replay)")
+    reps = int(reps) if reps is not None else 3
+
+    with trace_mod.span("profile",
+                        root=f"{type(root).__name__}#{root._id}"):
+        plan_key, rctx = base.plan_signature(root, mesh)
+        plan = base.lookup_plan(plan_key)
+        if plan is None:
+            plan, _dag, _leaves = base._build_plan(root, mesh, rctx,
+                                                   plan_key)
+        digest = key_hash(plan_key)
+        if plan is None:
+            # the optimizer collapsed the root onto a held result:
+            # there is no dispatch to attribute
+            return DeviceProfile("none", digest, 0.0, [],
+                                 note="optimized DAG already carries "
+                                      "a result; nothing to dispatch")
+        if plan.report is not None:
+            digest = plan.report.get("plan_key") or digest
+        attr = _attribution_for(digest, root, mesh)
+        if attr is None or attr.empty:
+            return DeviceProfile("none", digest, 0.0, [],
+                                 note="nothing to dispatch")
+        args = attr.args_from_raw(rctx.leaves)
+        with mesh_mod.use_mesh(mesh):
+            wall = _time_call(attr.node_fn(attr.dag), args, reps)
+            prof = _profile_impl(attr, args, wall, tier, reps, digest)
+    _record(prof, plan)
+    return prof
+
+
+def export_merged(path: Optional[str] = None,
+                  profile: Optional[DeviceProfile] = None
+                  ) -> Dict[str, Any]:
+    """``st.profile_export(path)``: one Perfetto-loadable Chrome trace
+    merging the host span ring (``obs.trace``) with a device timeline
+    (the given profile, else the most recent one). Returns the
+    document; also writes it to ``path`` when given."""
+    doc = trace_mod.export()
+    prof = profile if profile is not None else _last_profile
+    if prof is not None:
+        doc["traceEvents"] = list(doc["traceEvents"]) \
+            + prof.trace_events()
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        from ..utils.log import log_info  # lazy: log-free at import
+
+        log_info("profile: %d event(s) written to %s (host spans + "
+                 "device timeline; load at https://ui.perfetto.dev)",
+                 len(doc["traceEvents"]), path)
+    return doc
+
+
+def last_profile() -> Optional[DeviceProfile]:
+    with _lock:
+        return _last_profile
+
+
+# -- sampled continuous profiling (the dispatch-time wrapper) -------------
+
+
+def maybe_sample(expr: Any, plan: Any, phase_name: str, seconds: float,
+                 leaves: List[Any], dpos: List[int], mesh: Any) -> None:
+    """``expr/base._dispatch``'s hook, called only when
+    ``FLAGS.profile_sample_every`` > 0 (the off path is the caller's
+    one flag read). Profiles every Nth WARM dispatch of each plan —
+    after the real dispatch, off the result path, so the served result
+    is bit-equal to an unsampled run. Donating dispatches are never
+    sampled (their buffers are already released)."""
+    n = _SAMPLE_FLAG._value
+    if n <= 0 or phase_name != "dispatch" or dpos:
+        return
+    report = plan.report
+    digest = report.get("plan_key") if report else None
+    if digest is None:
+        return
+    with _lock:
+        c = _sample_counts.get(digest, 0) + 1
+        _sample_counts[digest] = c
+    if c % max(1, n) != 0:
+        return
+    try:
+        with trace_mod.span("profile_sample", plan=digest):
+            attr = _attribution_for(digest, expr, mesh)
+            if attr is None or attr.empty:
+                return
+            args = attr.args_from_raw(leaves)
+            tier = (_TIER_FLAG._value or "auto").lower()
+            if tier not in ("auto", "xplane", "replay"):
+                tier = "auto"
+            prof = _profile_impl(attr, args, wall_s=seconds, tier=tier,
+                                 reps=1, digest=digest)
+        _record(prof, plan)
+        # the serve worker stamps the request's flight record from
+        # this thread-local (the sample ran on the worker's thread)
+        _tls.last_sample = {
+            "plan": digest, "tier": prof.tier,
+            "device_s": round(prof.attributed_s, 6),
+            "attributed_fraction": round(prof.attributed_fraction, 4),
+        }
+    except Exception:  # noqa: BLE001 - sampling must never fail a
+        # served request; the error count is the alarm
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "profile_sample_errors",
+                "sampled profiling attempts that raised (the served "
+                "dispatch was unaffected)").inc()
+
+
+def take_last_sample() -> Optional[Dict[str, Any]]:
+    """Pop this thread's last sampled-profile stamp (the serve worker
+    folds it into the request's flight record as a 'profiled' event)."""
+    s = getattr(_tls, "last_sample", None)
+    if s is not None:
+        _tls.last_sample = None
+    return s
+
+
+def reset() -> None:
+    """Drop sampler counters, cached attributions and the last profile
+    (test isolation)."""
+    global _last_profile
+    with _lock:
+        _sample_counts.clear()
+        _attr_cache.clear()
+        _last_profile = None

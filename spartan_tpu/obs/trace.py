@@ -89,6 +89,18 @@ def annotate(name: str) -> Iterator[None]:
         yield
 
 
+@contextlib.contextmanager
+def named_scope(name: str) -> Iterator[None]:
+    """Sanctioned trace-time ``jax.named_scope`` wrapper (lint rule 11:
+    raw named scopes live only in ``expr/base.py`` — where the
+    per-node digest-carrying scopes are emitted — and ``obs/``).
+    For a fixed label inside a lowering, e.g. the ``st.loop`` body."""
+    import jax
+
+    with jax.named_scope(name):
+        yield
+
+
 class Span:
     """One completed (or in-flight) span. ``ts``/``dur`` are in
     microseconds since the process trace epoch, matching the Chrome

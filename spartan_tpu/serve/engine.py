@@ -46,6 +46,7 @@ from ..expr import base
 from ..obs import flight as flight_mod
 from ..obs import ledger as ledger_mod
 from ..obs import numerics as numerics_mod
+from ..obs import profile as profile_mod
 from ..obs import trace as trace_mod
 from ..obs.explain import key_hash
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
@@ -384,6 +385,13 @@ class ServeEngine:
             if ledger_mod._LEDGER_FLAG._value:
                 ledger_mod.note_service(key_hash(req.plan_key),
                                         predicted_s, sw.elapsed)
+            if profile_mod._SAMPLE_FLAG._value > 0:
+                # the sampled profiler ran on THIS worker thread during
+                # the dispatch: stamp the request's flight record so
+                # sampled requests are identifiable after the fact
+                samp = profile_mod.take_last_sample()
+                if samp is not None:
+                    flight_mod.note(req.rid, "profiled", **samp)
 
     def _shed_expired(self, batch: List[_Request]) -> List[_Request]:
         live: List[_Request] = []

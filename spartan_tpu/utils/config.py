@@ -209,7 +209,11 @@ FLAGS.define_float(
 FLAGS.define_bool("opt_fold_slices", True,
                   "Fold slice-of-slice and slice-of-map expressions.")
 FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
-FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force().")
+# The legacy FLAGS.profile whole-dispatch jax.profiler wrap is gone:
+# profiling is one entry point now — st.profile(expr) for one-shot
+# attribution and FLAGS.profile_sample_every (obs/profile.py) for
+# sampled continuous profiling in production; ad-hoc captures go
+# through utils/profiling.profile_trace (obs.trace.device_profile).
 # The observability layer's own switches (spartan_tpu/obs/) are defined
 # where they are consumed and documented here for discoverability:
 #   trace                (obs/trace.py, default True)  — record host spans
@@ -246,6 +250,15 @@ FLAGS.define_bool("profile", False, "Enable jax.profiler traces around force()."
 #       — per-request serve-path flight recorder (st.flightrec):
 #       submit -> queue -> coalesce -> dispatch -> resolve -> fetch
 #       events, ring-bounded, no new locks on the hot paths.
+#   profile_sample_every (obs/profile.py, default 0) — sampled
+#       continuous device-time profiling: every Nth warm dispatch of a
+#       plan is attributed per expr node and folded into the ledger's
+#       device columns / plan report / flight recorder; 0 = off (one
+#       flag read per dispatch; benchmarks/profile_overhead.py gate).
+#   profile_tier (obs/profile.py, default "auto") — attribution tier:
+#       auto (XPlane capture-parse, replay fallback) | xplane | replay.
+#   profile_max_nodes (obs/profile.py, default 128) — replay-tier
+#       node budget per plan.
 # The resilience layer's switches (spartan_tpu/resilience/) likewise
 # live with their consumers (docs/RESILIENCE.md):
 #   resilience           (engine.py, default True)  — master switch for
@@ -284,8 +297,13 @@ FLAGS.define_bool(
     "opaque fori_loop blob. Changes the lowered program (the flag is "
     "part of the loop's structural signature), so toggling recompiles; "
     "off by default — per-step callbacks serialize device->host.")
-FLAGS.define_str("profile_dir", "/tmp/spartan_tpu_profile",
-                 "Where profiler traces are written.")
+FLAGS.define_str(
+    "profile_dir", "/tmp/spartan_tpu_profile",
+    "Default destination for EXPLICIT device-profile captures "
+    "(utils/profiling.profile_trace -> obs.trace.device_profile; view "
+    "in TensorBoard/Perfetto). st.profile's XPlane tier and the "
+    "profile_sample_every sampler capture into throwaway temp dirs — "
+    "they parse and delete, never writing here.")
 FLAGS.define_str(
     "compilation_cache_dir", "",
     "Enable JAX's persistent compilation cache at this path (empty = "

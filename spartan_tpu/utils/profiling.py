@@ -7,6 +7,14 @@ timing harness (``block_until_ready`` returns early on tunneled
 platforms), per-expr HLO cost from ``compiled.cost_analysis()``, and
 device memory stats.
 
+Profiling entry points (one funnel since the device-time attribution
+PR): ``st.profile(expr)`` / ``FLAGS.profile_sample_every``
+(``obs/profile.py``) are THE way to measure where device time goes —
+the legacy ``FLAGS.profile`` whole-dispatch wrap is gone.
+:func:`profile_trace` remains for explicit raw captures (a TensorBoard
+session over a driver loop) and writes to ``FLAGS.profile_dir``; the
+attribution tiers capture into throwaway temp dirs instead.
+
 Since the observability PR this module is a thin facade over
 ``spartan_tpu/obs``: counters and per-phase timers live in the typed
 metrics registry (``obs.metrics.REGISTRY``; snapshot via

@@ -259,3 +259,33 @@ def test_calibration_without_profile_is_identity():
     FLAGS.cost_calibration = True  # on, but no profile installed
     assert ledger.factors() is None
     assert _best(build) == best0
+
+
+def test_fit_profile_prefers_device_columns():
+    """Entries carrying device columns (obs/profile sampled
+    attribution) contribute per-class rows — the fitted factors track
+    WHERE the device spent time, not one blended dispatch wall — and
+    the profile's meta records the device-time provenance."""
+    # predicted: map and reduce cost the same; measured device time:
+    # map is 4x hotter than reduce
+    ledger.ingest("dev-plan", {"map": 100.0, "reduce": 100.0}, 0.005)
+    ledger.note_device_profile(
+        "dev-plan", "replay", wall_s=0.005, attributed_s=0.005,
+        class_seconds={"map": 0.004, "reduce": 0.001})
+    prof = ledger.fit_profile()
+    assert prof is not None
+    assert prof.meta["source"] == "device_time"
+    assert prof.meta["device_rows"] == 2
+    ratio = prof.factors["map"] / prof.factors["reduce"]
+    assert 3.0 < ratio < 5.0  # the 4x device skew, not the blend
+
+
+def test_fit_profile_host_wall_fallback_source():
+    """Entries WITHOUT device columns still fit from dispatch wall,
+    and the profile says so (v2 provenance, satellite 6)."""
+    ledger.ingest("host-plan-a", {"map": 100.0}, 0.002)
+    ledger.ingest("host-plan-b", {"reduce": 100.0}, 0.001)
+    prof = ledger.fit_profile()
+    assert prof is not None
+    assert prof.meta["source"] == "host_wall"
+    assert prof.meta["device_rows"] == 0

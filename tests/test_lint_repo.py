@@ -291,17 +291,25 @@ def test_catches_raw_profiling(tmp_path):
 
 
 def test_raw_profiling_allowed_in_owners():
-    tree = ast.parse(
+    # per-entry-point allowlists (device-time attribution PR): the
+    # capture seam lives in obs/trace.py + obs/profile.py, the
+    # compiled-program introspection in obs/explain.py +
+    # resilience/memory.py — neither owner inherits the other's right
+    profiler_tree = ast.parse(
         "import jax\n"
         "with jax.profiler.trace('/tmp/t'):\n"
-        "    pass\n"
+        "    pass\n")
+    analysis_tree = ast.parse(
         "a = compiled.cost_analysis()\n"
         "m = compiled.memory_analysis()\n")
     for rel in (os.path.join("spartan_tpu", "obs", "trace.py"),
-                os.path.join("spartan_tpu", "obs", "explain.py"),
+                os.path.join("spartan_tpu", "obs", "profile.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_raw_profiling(path, profiler_tree) == []
+    for rel in (os.path.join("spartan_tpu", "obs", "explain.py"),
                 os.path.join("spartan_tpu", "resilience", "memory.py")):
         path = os.path.join(lint_repo.REPO, rel)
-        assert lint_repo.lint_raw_profiling(path, tree) == []
+        assert lint_repo.lint_raw_profiling(path, analysis_tree) == []
     # non-call attribute reads (docs, function defs) are NOT flagged,
     # and unrelated .profiler attributes (not jax's) pass
     other = ast.parse("fn = obj.cost_analysis\n"
@@ -309,6 +317,59 @@ def test_raw_profiling_allowed_in_owners():
                       "def cost_analysis(expr):\n"
                       "    return None\n")
     assert lint_repo.lint_raw_profiling("/x/y.py", other) == []
+
+
+def test_rule9_tightened_within_obs():
+    # obs/ membership alone no longer grants either right: a capture
+    # in obs/explain.py and an analysis call in obs/trace.py are both
+    # findings — obs/profile.py is the ONE new sanctioned jax.profiler
+    # consumer, not the whole package
+    profiler_tree = ast.parse(
+        "import jax\n"
+        "with jax.profiler.trace('/tmp/t'):\n"
+        "    pass\n")
+    analysis_tree = ast.parse("a = compiled.cost_analysis()\n")
+    explain = os.path.join(lint_repo.REPO, "spartan_tpu", "obs",
+                           "explain.py")
+    trace = os.path.join(lint_repo.REPO, "spartan_tpu", "obs",
+                         "trace.py")
+    assert any(f.rule == "raw-profiling" for f in
+               lint_repo.lint_raw_profiling(explain, profiler_tree))
+    assert any(f.rule == "raw-profiling" for f in
+               lint_repo.lint_raw_profiling(trace, analysis_tree))
+
+
+def test_catches_raw_named_scope(tmp_path):
+    bad = tmp_path / "scoped.py"
+    bad.write_text(
+        "import jax\n"
+        "from jax import named_scope\n"
+        "with jax.named_scope('my_kernel'):\n"
+        "    pass\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_named_scopes(str(bad), tree)
+    assert sum(f.rule == "raw-named-scope" for f in findings) == 2
+    # ... and the sanctioned wrapper is named in the remedy
+    assert all("obs.trace.named_scope" in f.message for f in findings)
+
+
+def test_named_scope_allowed_in_owners():
+    tree = ast.parse(
+        "import jax\n"
+        "with jax.named_scope('MapExpr_3__sg_ab12'):\n"
+        "    pass\n")
+    for rel in (os.path.join("spartan_tpu", "expr", "base.py"),
+                os.path.join("spartan_tpu", "obs", "trace.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_named_scopes(path, tree) == []
+    # expr/loop.py is NOT allowed raw scopes any more (it routes
+    # through obs.trace.named_scope), and non-jax scopes pass
+    loop = os.path.join(lint_repo.REPO, "spartan_tpu", "expr",
+                        "loop.py")
+    assert any(f.rule == "raw-named-scope"
+               for f in lint_repo.lint_named_scopes(loop, tree))
+    other = ast.parse("with torch.named_scope('x'):\n    pass\n")
+    assert lint_repo.lint_named_scopes("/x/y.py", other) == []
 
 
 def test_raw_memory_stats_allowed_in_owners(tmp_path):

@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Ten repo-specific rules that generic linters cannot know:
+Eleven repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -82,12 +82,16 @@ Ten repo-specific rules that generic linters cannot know:
    and the ``device_*`` gauges. Go through
    ``obs.metrics.device_memory_aggregate()``.
 
-9. No raw ``jax.profiler`` use and no direct ``.cost_analysis()`` /
-   ``.memory_analysis()`` calls outside ``obs/`` and
+9. No raw ``jax.profiler`` use outside ``obs/trace.py`` and
+   ``obs/profile.py`` (tightened by the device-time attribution PR:
+   the tracer owns the capture seam, the profiler is the ONE new
+   sanctioned consumer), and no direct ``.cost_analysis()`` /
+   ``.memory_analysis()`` calls outside ``obs/explain.py`` and
    ``resilience/memory.py`` (the cost-ledger PR): every device-time
    measurement and compiled-program introspection must flow through
    the sanctioned entry points (``obs.trace.device_profile`` /
-   ``.annotate``, ``obs.explain.compiled_cost_analysis``,
+   ``.annotate``, ``obs.profile.profile``,
+   ``obs.explain.compiled_cost_analysis``,
    ``resilience.memory.validate_plan``) so the reading lands in the
    cost ledger next to the model's prediction — a stray profiler
    capture or cost read-out produces numbers the calibration loop
@@ -104,6 +108,14 @@ Ten repo-specific rules that generic linters cannot know:
     producing layout is known so the edge is plannable); the two
     allowed files are the planner itself and the ``Expr.lower`` /
     jit-output seam that defines the fallback.
+
+11. No raw ``jax.named_scope`` outside ``expr/base.py`` and ``obs/``
+    (the device-time attribution PR): the per-node scopes
+    ``Expr.lower`` emits carry the structural-signature digest the
+    profiler's trace-parse tier JOINS on (``obs/profile.scope_name``),
+    and ``obs.trace.named_scope`` is the sanctioned wrapper for fixed
+    labels — a raw scope elsewhere invents names the attribution
+    report can never map back to an expr node.
 
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
@@ -169,16 +181,31 @@ _MEMSTATS_ALLOWED_FILES = {
     os.path.join("spartan_tpu", "resilience", "memory.py"),
 }
 
-# rule 9: device-time instrumentation single-sourcing — raw
-# jax.profiler use and compiled cost/memory introspection live in the
-# observability layer (+ the memory governor, whose validate_plan is
-# the one memory_analysis consumer), so every reading can land in the
+# rule 9: device-time instrumentation single-sourcing, per entry
+# point. Raw jax.profiler use lives in the tracer's capture seam plus
+# the attribution profiler (its ONE sanctioned new consumer); compiled
+# cost/memory introspection lives with explain's normalizer and the
+# memory governor's validate_plan — so every reading can land in the
 # cost ledger
-_PROFILING_ALLOWED_DIRS = (os.path.join("spartan_tpu", "obs") + os.sep,)
-_PROFILING_ALLOWED_FILES = {
+_PROFILER_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "obs", "trace.py"),
+    os.path.join("spartan_tpu", "obs", "profile.py"),
+}
+_ANALYSIS_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "obs", "explain.py"),
     os.path.join("spartan_tpu", "resilience", "memory.py"),
 }
 _ANALYSIS_CALLS = {"cost_analysis", "memory_analysis"}
+
+# rule 11: raw jax.named_scope sites — the digest-carrying per-node
+# scopes (expr/base.Expr.lower via obs/profile.scope_name) and the
+# obs layer's own wrapper; everyone else goes through
+# obs.trace.named_scope so scope names stay joinable by the profiler
+_NAMED_SCOPE_ALLOWED_DIRS = (os.path.join("spartan_tpu", "obs")
+                             + os.sep,)
+_NAMED_SCOPE_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "expr", "base.py"),
+}
 
 # rule 10: the only places allowed to call with_sharding_constraint
 # directly — the redistribution planner (which decides explicit
@@ -522,14 +549,16 @@ def lint_raw_memory_stats(path: str, tree: ast.AST) -> List[Finding]:
 
 
 def lint_raw_profiling(path: str, tree: ast.AST) -> List[Finding]:
-    """Rule 9: no raw jax.profiler use and no direct cost_analysis /
-    memory_analysis calls outside obs/ + resilience/memory.py — a
+    """Rule 9: no raw jax.profiler use outside obs/trace.py +
+    obs/profile.py, and no direct cost_analysis / memory_analysis
+    calls outside obs/explain.py + resilience/memory.py — a
     measurement that bypasses the sanctioned entry points never
     reaches the cost ledger, so it can't be compared against the
     models it should be validating."""
     rel = os.path.relpath(path, REPO)
-    if rel in _PROFILING_ALLOWED_FILES or any(
-            rel.startswith(d) for d in _PROFILING_ALLOWED_DIRS):
+    profiler_ok = rel in _PROFILER_ALLOWED_FILES
+    analysis_ok = rel in _ANALYSIS_ALLOWED_FILES
+    if profiler_ok and analysis_ok:
         return []
     findings: List[Finding] = []
 
@@ -539,11 +568,14 @@ def lint_raw_profiling(path: str, tree: ast.AST) -> List[Finding]:
             f"{what}: device-time measurement and compiled-program "
             "introspection are single-sourced so readings land in the "
             "cost ledger — use obs.trace.device_profile/.annotate, "
+            "obs.profile.profile (the attribution profiler), "
             "obs.explain.compiled_cost_analysis, or "
             "resilience.memory.validate_plan"))
 
     for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr == "profiler":
+        if profiler_ok:
+            pass
+        elif isinstance(node, ast.Attribute) and node.attr == "profiler":
             root = node.value
             while isinstance(root, ast.Attribute):
                 root = root.value
@@ -560,10 +592,45 @@ def lint_raw_profiling(path: str, tree: ast.AST) -> List[Finding]:
             for a in node.names:
                 if a.name.startswith("jax.profiler"):
                     flag(node, f"import {a.name}")
-        elif (isinstance(node, ast.Call)
+        if (not analysis_ok and isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr in _ANALYSIS_CALLS):
             flag(node, f"direct .{node.func.attr}() call")
+    return findings
+
+
+def lint_named_scopes(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 11: no raw jax.named_scope outside expr/base.py + obs/ —
+    scope names are the profiler's join key (the digest-carrying
+    per-node scopes), so an ad-hoc scope elsewhere is a device-trace
+    name the attribution report can never map to an expr node."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _NAMED_SCOPE_ALLOWED_FILES or any(
+            rel.startswith(d) for d in _NAMED_SCOPE_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "raw-named-scope",
+            f"{what}: trace-time scope names are the device-time "
+            "profiler's join key — use obs.trace.named_scope for a "
+            "fixed label (expr/base.Expr.lower owns the per-node "
+            "digest-carrying scopes)"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "named_scope":
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "jax":
+                flag(node, "raw jax.named_scope use")
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").startswith("jax") and any(
+                    a.name == "named_scope"
+                    or a.asname == "named_scope" for a in node.names):
+                flag(node, "binds jax.named_scope directly")
     return findings
 
 
@@ -688,6 +755,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_mesh_capture(path, tree))
         findings.extend(lint_raw_memory_stats(path, tree))
         findings.extend(lint_raw_profiling(path, tree))
+        findings.extend(lint_named_scopes(path, tree))
         findings.extend(lint_sharding_constraints(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
