@@ -108,6 +108,20 @@ def _from_run_all(doc: Dict[str, Any]) -> Dict[str, float]:
              ("redistribution_overhead", "redist_off_overhead_ratio")),
             ("profile_off_overhead_ratio",
              ("profile_overhead", "profile_off_overhead_ratio")),
+            ("kernels_off_overhead_ratio",
+             ("native_overhead", "kernels_off_overhead_ratio")),
+            ("native_kmeans_speedup",
+             ("native_overhead", "native_kmeans_speedup")),
+            ("native_topk_speedup",
+             ("native_overhead", "native_topk_speedup")),
+            ("native_histogram_speedup",
+             ("native_overhead", "native_histogram_speedup")),
+            ("native_sort_exchange_speedup",
+             ("native_overhead", "native_sort_exchange_speedup")),
+            ("native_stencil_speedup",
+             ("native_overhead", "native_stencil_speedup")),
+            ("native_segment_speedup",
+             ("native_overhead", "native_segment_speedup")),
     ):
         v = get(*path)
         if v is not None:

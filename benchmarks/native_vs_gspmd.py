@@ -1,0 +1,228 @@
+"""Pallas kernel layer: off-path gate + per-op native-vs-GSPMD A/B
+(ISSUE 12 gates; docs/KERNELS.md).
+
+Two measurements, printed as ONE JSON line:
+
+* ``kernels_off_overhead_ratio`` — the layer's toll on the
+  steady-state k-means-step hit path with ``FLAGS.native_kernels`` at
+  its default (auto -> GSPMD off-TPU). The selection hooks are
+  trace-time only and ``policy_key()`` is folded into the memoized
+  flags key, so the hit path has NO kernel-layer code at all: the
+  real module is measured against a null shim of the one binding
+  ``expr/base`` holds, interleaved arms, medians. <=0.01 committed
+  for BOTH cpu and tpu (benchmarks/thresholds.json).
+
+* per-op A/B — for each kernel slot (histogram/bincount, topk, the
+  sample sort's exchange pack, segment-sum, k-means, stencil) the
+  same computation with ``native_kernels=on`` vs ``off``, ABBA
+  interleaved, medians; ``native_<op>_speedup`` = t_gspmd/t_native.
+  On CPU the native arm runs Pallas INTERPRET mode, so the numbers
+  are parity evidence, reported UNJUDGED; the TPU floors committed in
+  thresholds.json gate the next TPU run — a kernel that cannot hold
+  its floor there loses its slot (the measured-win contract).
+  ``segment`` is reported without a floor: its Pallas form already
+  measured WORSE than XLA's scatter on v5e (ops/segment.py), which is
+  exactly why auto keeps it off.
+
+Usage: python benchmarks/native_vs_gspmd.py [--iters N] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _NullKernels:
+    """expr/base.py's kernel-layer binding with the policy erased —
+    what the dispatch path looks like with no kernel layer at all."""
+
+    @staticmethod
+    def policy_key():
+        return ("gspmd", True)
+
+
+def _median(xs):
+    return float(np.median(xs))
+
+
+def _off_ratio(iters: int, n: int, d: int, k: int) -> dict:
+    import spartan_tpu as st
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr import base as expr_base
+    from spartan_tpu.expr.base import ValExpr
+    from spartan_tpu.utils import profiling
+
+    rng = np.random.RandomState(0)
+    pts = st.from_numpy(rng.rand(n, d).astype(np.float32))
+    c = st.as_expr(rng.rand(k, d).astype(np.float32)).evaluate()
+
+    real = expr_base.kernels_mod
+
+    def step(cur):
+        return kmeans_step(pts, ValExpr(cur), k).evaluate()
+
+    c = step(step(c))  # warm the plan so every iteration is a hit
+
+    times = {"base": [], "off": []}
+    try:
+        for i in range(iters):
+            # ABBA: alternate which arm leads each pair — the 1-core
+            # box's timesharing bursts hit lead and trail positions
+            # equally (the redistribution-gate estimator's rationale)
+            order = (("base", "off") if i % 2 == 0
+                     else ("off", "base"))
+            for arm in order:
+                expr_base.kernels_mod = (_NullKernels if arm == "base"
+                                         else real)
+                with profiling.stopwatch() as sw:
+                    c = step(c)
+                    c.glom()
+                times[arm].append(sw.elapsed)
+    finally:
+        expr_base.kernels_mod = real
+
+    t_base = _median(times["base"])
+    t_off = _median(times["off"])
+    return {
+        "wall_us_per_iter_base": round(t_base * 1e6, 1),
+        "wall_us_per_iter_kernels_off": round(t_off * 1e6, 1),
+        "kernels_off_overhead_ratio": round(
+            max(0.0, t_off / t_base - 1.0), 4),
+    }
+
+
+def _ab_ops(n: int, reps: int) -> dict:
+    """Per-op ABBA A/B: evaluate the same structure under both
+    backends (distinct plan keys -> both warm in the plan cache), time
+    alternating arms, speedup = gspmd/native."""
+    import jax
+    import jax.numpy as jnp
+
+    import spartan_tpu as st
+    from spartan_tpu.array import tiling
+    from spartan_tpu.ops import kmeans as kk
+    from spartan_tpu.ops.segment import segment_sum
+    from spartan_tpu.parallel import mesh as mesh_mod
+    from spartan_tpu.utils import profiling
+    from spartan_tpu.utils.config import FLAGS
+
+    rng = np.random.RandomState(1)
+    mesh = mesh_mod.get_mesh()
+    p = max(int(mesh.shape.get(tiling.AXIS_ROW, 1)), 1)
+    x1 = rng.rand(n).astype(np.float32)
+    xi = rng.randint(0, 64, n).astype(np.int32)
+
+    def ev_hist():
+        return st.histogram(x1, bins=64, range=(0.0, 1.0))[0].glom()
+
+    def ev_topk():
+        return st.topk(x1, min(32, max(1, n // p)))[1].glom()
+
+    def ev_sort():
+        return st.sort(x1).glom()
+
+    seg_vals = jnp.asarray(rng.rand(n, 8).astype(np.float32))
+    seg_ids = jnp.asarray(xi)
+
+    def ev_segment():
+        impl = "pallas" if FLAGS.native_kernels == "on" else "xla"
+        return np.asarray(segment_sum(seg_vals, seg_ids, 64,
+                                      impl=impl))
+
+    km_n, km_d, km_k = p * 1024, 128, 16
+    km_pts = jnp.asarray(rng.rand(km_n, km_d).astype(np.float32))
+    km_c0 = np.asarray(km_pts[:km_k])
+    from spartan_tpu.examples.kmeans import kmeans_step
+    from spartan_tpu.expr.base import ValExpr
+
+    km_pts_e = st.from_numpy(np.asarray(km_pts))
+
+    def ev_kmeans():
+        if FLAGS.native_kernels == "on":
+            out = kk.step(km_pts, jnp.asarray(km_c0), km_k)
+            return np.asarray(jax.block_until_ready(out))
+        return kmeans_step(km_pts_e, ValExpr(
+            st.as_expr(km_c0).evaluate()), km_k).glom()
+
+    img = rng.rand(2, 8 * p, 16, 8).astype(np.float32)
+    flt = rng.rand(3, 3, 8, 8).astype(np.float32)
+
+    def ev_stencil():
+        xe = st.as_expr(img)
+        xe._forced_tiling = tiling.Tiling(
+            (None, tiling.AXIS_ROW, None, None))
+        return st.stencil(xe, flt).glom()
+
+    ops = {
+        "histogram": ev_hist,
+        "topk": ev_topk,
+        "sort_exchange": ev_sort,
+        "segment": ev_segment,
+        "kmeans": ev_kmeans,
+        "stencil": ev_stencil,
+    }
+    out = {}
+    saved = FLAGS.native_kernels
+    try:
+        for name, fn in ops.items():
+            # warm both arms (plan-cache / jit-cache misses paid here)
+            for arm in ("off", "on"):
+                FLAGS.native_kernels = arm
+                fn()
+            times = {"on": [], "off": []}
+            order = ("on", "off", "off", "on")  # ABBA
+            for _ in range(reps):
+                for arm in order:
+                    FLAGS.native_kernels = arm
+                    with profiling.stopwatch() as sw:
+                        fn()
+                    times[arm].append(sw.elapsed)
+            t_on = _median(times["on"])
+            t_off = _median(times["off"])
+            out[f"native_{name}_us"] = round(t_on * 1e6, 1)
+            out[f"gspmd_{name}_us"] = round(t_off * 1e6, 1)
+            out[f"native_{name}_speedup"] = round(t_off / t_on, 4)
+    finally:
+        FLAGS.native_kernels = saved
+    return out
+
+
+def measure(iters: int = 60, n: int = 4096, reps: int = 3) -> dict:
+    import jax
+
+    from spartan_tpu.kernels import registry as kreg
+
+    rec = {
+        "metric": "native_vs_gspmd",
+        "platform": jax.devices()[0].platform,
+        "mode_default": kreg.mode(),
+        "interpret": kreg.interpret_mode(),
+        "iters": iters,
+        "n": n,
+    }
+    rec.update(_off_ratio(iters, n=max(n, 512), d=32, k=16))
+    rec.update(_ab_ops(n, reps))
+    # CPU runs the native arm in interpret mode: the A/B is parity
+    # evidence there, judged only on TPU (thresholds.json floors)
+    rec["ab_judged_here"] = not kreg.interpret_mode()
+    return rec
+
+
+def main() -> None:
+    iters = 60
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+    small = "--small" in sys.argv
+    out = measure(iters=iters, n=1024 if small else 4096,
+                  reps=2 if small else 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -68,10 +68,22 @@ def assign_points(points: Expr, centers: Expr) -> Expr:
                 out_tiling=tiling_mod.Tiling((points.out_tiling().axes[0],)))
 
 
+def _kernel_pad(n: int) -> int:
+    """Pad rows so every mesh row shard holds whole 1024-point blocks
+    (the kernel is per-shard now — docs/KERNELS.md)."""
+    from ..ops import kmeans as kmeans_kernel
+    from ..parallel import mesh as mesh_mod
+
+    p = max(int(mesh_mod.get_mesh().shape.get(
+        tiling_mod.AXIS_ROW, 1)), 1)
+    q = p * kmeans_kernel._BLOCK
+    return -(-n // q) * q
+
+
 def _kernel_supports(n: int, d: int, k: int) -> bool:
     from ..ops import kmeans as kmeans_kernel
 
-    return kmeans_kernel.supports(-(-n // 1024) * 1024, d, k)
+    return kmeans_kernel.supports(_kernel_pad(n), d, k)
 
 
 def kmeans(points, k: int, num_iter: int = 10,
@@ -101,7 +113,7 @@ def kmeans(points, k: int, num_iter: int = 10,
         from ..ops import kmeans as kmeans_kernel
 
         pts = points.evaluate().jax_array
-        npad = -(-n // 1024) * 1024
+        npad = _kernel_pad(n)
         if npad != n:
             pts = jnp.concatenate(
                 [pts, jnp.zeros((npad - n, d), pts.dtype)])

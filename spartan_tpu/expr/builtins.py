@@ -259,6 +259,47 @@ def triu(x, k=0) -> Expr:
     return map_expr(lambda v: jnp.triu(v, k), as_expr(x))
 
 
+class BincountExpr(Expr):
+    """Counts of ints in ``[0, length)`` — the histogram family's
+    reduction. Lowers through the kernel layer (docs/KERNELS.md): when
+    ``kernels.select`` picks Pallas, each row shard counts its entries
+    with the blocked one-hot kernel (spartan_tpu/kernels/histogram.py)
+    and the count rows merge with one psum; otherwise the traced
+    ``jnp.bincount`` (XLA scatter-add, GSPMD-partitioned). Negative
+    ids clip to bucket 0 and ids >= length are dropped on both
+    backends (jnp.bincount parity)."""
+
+    def __init__(self, x: Expr, length: int):
+        self.x = x
+        self.length = int(length)
+        super().__init__((self.length,), np.int32)
+
+    def children(self):
+        return (self.x,)
+
+    def replace_children(self, new_children) -> "BincountExpr":
+        return BincountExpr(new_children[0], self.length)
+
+    def _lower(self, env) -> Any:
+        from ..kernels import registry as kernels_mod
+
+        v = self.x.lower(env)
+        sel = kernels_mod.node_selection(self)
+        if sel is not None and sel.pallas:
+            from ..kernels import histogram as khist
+
+            return khist.bincount_sharded(v, self.length, sel)
+        return jnp.bincount(v.ravel(), length=self.length)
+
+    def _sig(self, ctx):
+        return ("bincount", self.length, ctx.of(self.x))
+
+    def _default_tiling(self):
+        from ..array import tiling as tiling_mod
+
+        return tiling_mod.replicated(1)
+
+
 def bincount(x, minlength: Optional[int] = None,
              length: Optional[int] = None) -> Expr:
     """Counts of nonnegative ints. A static ``length``/``minlength`` keeps
@@ -268,7 +309,7 @@ def bincount(x, minlength: Optional[int] = None,
     n = length or minlength
     if n is None:
         n = int(max(x).glom()) + 1
-    return map_expr(lambda v: jnp.bincount(v.ravel(), length=n), x)
+    return BincountExpr(x, n)
 
 
 def count_nonzero(x) -> Expr:

@@ -228,12 +228,25 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
     except Exception:  # noqa: BLE001 - the prediction is advisory
         pass
 
+    # kernel-backend decisions (spartan_tpu/kernels): the SAME pure
+    # select() the lowering seam will call per kernel-eligible node —
+    # backend, derived grid/block, and the fallback reason when GSPMD
+    # keeps the slot (docs/KERNELS.md)
+    kernel_nodes = None
+    try:
+        from ..kernels import registry as kernels_mod
+
+        kernel_nodes = kernels_mod.plan_entries(dag) or None
+    except Exception:  # noqa: BLE001 - the report is advisory
+        pass
+
     report: Dict[str, Any] = {
         "root": _label(expr),
         "site": _site_str(expr._site),
         "plan_key": key_hash(plan_key),
         "dp_cost": dp_cost,
         "cost_components": components,
+        "kernels": kernel_nodes,
         # the mesh generation this plan was built for: after an
         # elastic rebuild (device loss), post-recovery explains show
         # which epoch — and therefore which device set — a plan binds
@@ -339,6 +352,22 @@ class ExplainReport:
                               f"axis={cstrat['strategy']})")
                 lines.append(f"    {t['node']:<22} {str(t['shape']):<16} "
                              f"{str(t['tiling']):<14}{extra}")
+        if d.get("kernels"):
+            # kernel-lowered nodes: backend=pallas|gspmd + the grid
+            # the tiling derived (docs/KERNELS.md); fallbacks carry
+            # their reason so the A/B is readable from one explain
+            lines.append("  kernel nodes:")
+            for kn in d["kernels"]:
+                line = (f"    {kn['node']:<22} {kn['op']:<14} "
+                        f"backend={kn['backend']}")
+                if kn.get("grid") is not None:
+                    line += (f" grid={tuple(kn['grid'])} "
+                             f"block={tuple(kn['block'])}")
+                if kn.get("interpret"):
+                    line += " [interpret]"
+                if kn.get("reason"):
+                    line += f" ({kn['reason']})"
+                lines.append(line)
         if d.get("reshard_edges"):
             lines.append("  reshard edges:")
             for e in d["reshard_edges"]:

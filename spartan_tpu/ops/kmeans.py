@@ -1,145 +1,19 @@
 """Fused k-means iteration kernel (config 3, BASELINE.json:9).
 
-The expr-level iteration (examples/kmeans.py) lowers to XLA ops that
-materialize the (n, k) distance matrix in HBM several times (distance,
-argmin, one-hot merge) — measured 18.6 ms/iter at 1M x 128, k=64 on
-v5e against a ~1 ms HBM floor (points are read once: 512 MB).
-
-This Pallas kernel streams point blocks through VMEM once per
-iteration: per (B, d) block it computes the Gram matrix against the
-VMEM-resident centers on the MXU, takes the lane-wise argmin, builds the
-assignment one-hot, and accumulates ``one_hot.T @ points`` (MXU) and the
-counts into VMEM scratch, flushing (sums | counts) once at the end.
-``argmin(d2)`` needs only ``-2 G + |c|^2`` (the point norms are constant
-per row), so the distance matrix never exists anywhere.
-
-Constraints: f32 points, d a multiple of 128, k <= 128 (padded centers
-get +inf norms so the argmin never selects them), n a multiple of the
-block size (drivers pad the point array once). All matmuls run at
-HIGHEST precision so assignments match the f32 oracle.
+The kernel itself now lives on the partitionable kernel layer
+(``spartan_tpu/kernels/kmeans.py``, docs/KERNELS.md): the seed's
+single-device Pallas pass was promoted to a per-shard kernel under
+``shard_map`` over the row tiling with a psum merge, so multi-chip
+meshes run it too. This module keeps the historical entry points the
+drivers and benchmarks import (``supports`` / ``assign_accumulate`` /
+``step`` / ``run``); Pallas imports are confined to the kernel layer
+(lint rule 12).
 """
 
 from __future__ import annotations
 
-import functools
+from ..kernels.kmeans import (_BLOCK, _KPAD, assign_accumulate, run,
+                              step, supports)
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-_BLOCK = 1024
-_KPAD = 128
-
-
-def _available() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
-
-
-def supports(n: int, d: int, k: int) -> bool:
-    """Single TPU device only: the pallas_call is not partitionable, so
-    on a multi-chip mesh the distributed expr path stays the default."""
-    from ..parallel import mesh as mesh_mod
-
-    return (_available() and d % 128 == 0 and 0 < k <= _KPAD
-            and n % _BLOCK == 0
-            and mesh_mod.device_count(mesh_mod.get_mesh()) == 1)
-
-
-def assign_accumulate(points: jax.Array, centers: jax.Array, k: int,
-                      valid_rows: int | None = None
-                      ) -> tuple[jax.Array, jax.Array]:
-    """One fused pass: (k, d) cluster sums and (k,) counts.
-
-    ``points`` (n, d) f32 with n % 1024 == 0; ``centers`` (k, d).
-    Rows at index >= ``valid_rows`` (driver padding) are masked out of
-    the accumulation. Traceable (usable inside fori_loop — the k-means
-    driver runs all iterations as one dispatch)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    n, d = points.shape
-    kpad = _KPAD
-    # padded centers: zero rows with +inf norm so argmin skips them
-    cpad = jnp.zeros((kpad, d), jnp.float32).at[:k].set(centers)
-    cnorm = jnp.full((kpad,), jnp.inf, jnp.float32).at[:k].set(
-        jnp.sum(centers * centers, axis=1))
-    nsteps = n // _BLOCK
-    n_valid = n if valid_rows is None else int(valid_rows)
-
-    def kernel(p_ref, c_ref, cn_ref, sums_ref, cnt_ref, acc, cacc):
-        b = pl.program_id(0)
-
-        @pl.when(b == 0)
-        def _init():
-            acc[:] = jnp.zeros_like(acc)
-            cacc[:] = jnp.zeros_like(cacc)
-
-        p = p_ref[:]                                   # (B, d)
-        gram = jax.lax.dot_general(
-            p, c_ref[:], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)       # (B, kpad)
-        score = cn_ref[0, :][None, :] - 2.0 * gram
-        assign = jnp.argmin(score, axis=1)             # (B,)
-        oh = (assign[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (_BLOCK, kpad), 1)).astype(jnp.float32)
-        if n_valid < n:
-            row = (b * _BLOCK
-                   + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK, kpad), 0))
-            oh = oh * (row < n_valid).astype(jnp.float32)
-        acc[:] += jax.lax.dot_general(
-            oh, p, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)       # (kpad, d)
-        cacc[0, :] += jnp.sum(oh, axis=0)
-
-        @pl.when(b == pl.num_programs(0) - 1)
-        def _flush():
-            sums_ref[:] = acc[:]
-            cnt_ref[:] = cacc[:]
-
-    sums, cnt = pl.pallas_call(
-        kernel,
-        grid=(nsteps,),
-        in_specs=[
-            pl.BlockSpec((_BLOCK, d), lambda b: (b, 0)),
-            pl.BlockSpec((kpad, d), lambda b: (0, 0)),
-            pl.BlockSpec((1, kpad), lambda b: (0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((kpad, d), lambda b: (0, 0)),
-            pl.BlockSpec((1, kpad), lambda b: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((kpad, d), jnp.float32),
-            jax.ShapeDtypeStruct((1, kpad), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((kpad, d), jnp.float32),
-            pltpu.VMEM((1, kpad), jnp.float32),
-        ],
-        interpret=not _available(),
-    )(points, cpad, cnorm[None, :])
-    return sums[:k], cnt[0, :k]
-
-
-@functools.partial(jax.jit, static_argnames=("k", "valid_rows"))
-def step(points: jax.Array, centers: jax.Array, k: int,
-         valid_rows: int | None = None) -> jax.Array:
-    """One k-means update: new centers from one fused pass."""
-    sums, cnt = assign_accumulate(points, centers, k, valid_rows)
-    return sums / jnp.maximum(cnt, 1.0)[:, None]
-
-
-@functools.partial(jax.jit, static_argnames=("k", "valid_rows"))
-def run(points: jax.Array, centers: jax.Array, k: int,
-        iters: jax.Array, valid_rows: int | None = None) -> jax.Array:
-    """All iterations in one dispatch (traced loop bound)."""
-    def body(_, c):
-        sums, cnt = assign_accumulate(points, c, k, valid_rows)
-        return sums / jnp.maximum(cnt, 1.0)[:, None]
-
-    return jax.lax.fori_loop(0, iters, body, centers)
+__all__ = ["supports", "assign_accumulate", "step", "run",
+           "_BLOCK", "_KPAD"]

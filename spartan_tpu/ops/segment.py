@@ -8,11 +8,17 @@ for scatter-add / segment-sum merges"). Three paths:
 * ``onehot`` — one-hot matmul: ``onehot(ids).T @ vals``. Turns the
   scatter into an MXU matmul — the TPU-first trick for small segment
   counts (k-means' k=64 centers, histogram merges).
-* ``pallas`` — blocked one-hot accumulation kernel: the entry stream is
-  tiled over a sequential grid, each tile builds its one-hot block in
-  VMEM and accumulates ``block.T @ vals`` into the output block (MXU),
-  avoiding XLA's general scatter. TPU only; falls back to ``onehot``
-  elsewhere.
+* ``pallas`` — the kernel layer's blocked one-hot accumulation kernel
+  (spartan_tpu/kernels/segment.py), shard_map-wrapped over the mesh
+  row axis with a psum-scatter merge on multi-device meshes.
+
+Backend selection is the kernel layer's policy (``kernels.select``,
+docs/KERNELS.md), not a per-call platform probe: ``auto`` keeps XLA's
+native scatter (it measured FASTER than the one-hot kernels on v5e —
+1M x 128, k=64: xla 33ms, onehot 67ms, pallas 71ms), and the Pallas
+path stays selectable explicitly (``impl="pallas"`` /
+``FLAGS.segment_impl``) or via ``FLAGS.native_kernels=on`` — the CPU
+CI parity mode that runs it in interpret mode.
 """
 
 from __future__ import annotations
@@ -23,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..array import tiling as tiling_mod
+from ..kernels import registry as kernels_mod
 from ..utils.config import FLAGS
 
 FLAGS.define_str("segment_impl", "auto",
@@ -48,71 +56,54 @@ def _segment_sum_onehot(vals: jax.Array, ids: jax.Array,
 
 
 def _pallas_available() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    """Back-compat probe (array/sparse.py): is the NATIVE Mosaic path
+    available here? The selection policy proper is kernels.select."""
+    return not kernels_mod.interpret_mode()
 
 
-def _segment_sum_pallas(vals: jax.Array, ids: jax.Array,
-                        num_segments: int,
-                        block_e: int = 512) -> jax.Array:
-    """Blocked one-hot accumulation on TPU.
+def _select(vals: jax.Array, num_segments: int,
+            force: bool = False) -> kernels_mod.Selection:
+    return kernels_mod.select(
+        "segment_sum", vals.shape, vals.dtype,
+        tiling_mod.row(max(vals.ndim, 1)), force=force,
+        num_segments=num_segments)
 
-    Grid over entry blocks (sequential on TPU); the output block is
-    revisited every step and accumulated in VMEM. ``num_segments`` and the
-    feature dim are padded to lane/sublane multiples.
-    """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    squeeze = vals.ndim == 1
-    if squeeze:
-        vals = vals[:, None]
-    e, d = vals.shape
-    k = num_segments
-    # pad to TPU tiling: entries to block_e, segments/features to 128/8
-    e_pad = -e % block_e
-    if e_pad:
-        vals = jnp.pad(vals, ((0, e_pad), (0, 0)))
-        ids = jnp.pad(ids, (0, e_pad), constant_values=k)  # out of range
-    k_pad = -k % 8
-    d_pad = -d % 128
-    vals = jnp.pad(vals, ((0, 0), (0, d_pad)))
-    n_blocks = vals.shape[0] // block_e
-    k_total = k + k_pad
-    # ids as (n_blocks, block_e): 2-D blocks match the XLA layout Mosaic
-    # expects (1-D s32 operands hit a T(1024)/T(512) tiling mismatch)
-    ids2d = ids.astype(jnp.int32).reshape(n_blocks, block_e)
+def segment_sum(vals: jax.Array, ids: jax.Array, num_segments: int,
+                impl: Optional[str] = None,
+                sorted_ids: bool = False) -> jax.Array:
+    """Sum ``vals`` rows into ``num_segments`` buckets by ``ids``.
 
-    def kernel(ids_ref, vals_ref, out_ref):
-        step = pl.program_id(0)
+    ids outside [0, num_segments) are dropped (XLA segment_sum
+    semantics), which the padding paths rely on. ``sorted_ids`` unlocks
+    XLA's sorted-scatter fast path (the SparseDistArray invariant)."""
+    from ..kernels import segment as ksegment
 
-        @pl.when(step == 0)
-        def _init():
-            out_ref[:] = jnp.zeros_like(out_ref)
+    impl = impl or FLAGS.segment_impl
+    forced = impl == "pallas"
+    if impl == "auto":
+        # the kernel-layer policy: XLA's native scatter measured
+        # faster than both matmul paths on v5e (module docstring), so
+        # auto selects pallas only under FLAGS.native_kernels=on (the
+        # parity/ablation mode)
+        sel = _select(vals, num_segments)
+        impl = "pallas" if sel.pallas else "xla"
+    if impl == "pallas":
+        sel = _select(vals, num_segments, force=forced)
+        if not sel.pallas:
+            impl = "onehot"  # constraint fallback (reason: sel.reason)
+        else:
+            return ksegment.segment_sum_sharded(vals, ids,
+                                                num_segments, sel)
+    if impl == "onehot":
+        return _segment_sum_onehot(vals, ids, num_segments)
+    return _segment_sum_xla(vals, ids, num_segments, sorted_ids)
 
-        seg = jax.lax.broadcasted_iota(jnp.int32, (block_e, k_total), 1)
-        onehot = (ids_ref[step, :][:, None] == seg).astype(vals_ref.dtype)
-        out_ref[:] += jnp.dot(onehot.T, vals_ref[:],
-                              preferred_element_type=out_ref.dtype,
-                              precision="highest")
 
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_blocks,),
-        in_specs=[
-            # whole ids table resident (Mosaic requires sublane-divisible
-            # or full blocks); the kernel row-indexes it by step
-            pl.BlockSpec((n_blocks, block_e), lambda i: (0, 0)),
-            pl.BlockSpec((block_e, vals.shape[1]), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((k_total, vals.shape[1]), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((k_total, vals.shape[1]),
-                                       vals.dtype),
-    )(ids2d, vals)
-    out = out[:k, :d]
-    return out[:, 0] if squeeze else out
+def segment_count(ids: jax.Array, num_segments: int,
+                  dtype=jnp.float32, impl: Optional[str] = None
+                  ) -> jax.Array:
+    return segment_sum(jnp.ones(ids.shape, dtype), ids, num_segments, impl)
 
 
 class SegmentPlan:
@@ -134,7 +125,8 @@ class SegmentPlan:
     matrix's rows); runtime value streams must be produced in plan order
     (use :meth:`reorder` on the host-side companion arrays at build
     time). Scratch residency bounds ``num_segments`` to ~2M on a 16 MB
-    VMEM part.
+    VMEM part. The kernel itself lives in spartan_tpu/kernels/segment.py
+    (lint rule 12: Pallas only under the kernel layer).
     """
 
     W = 1024          # output window (one (8,128) f32 block)
@@ -197,110 +189,19 @@ class SegmentPlan:
     def segment_sum(self, vals: jax.Array) -> jax.Array:
         """Sum a plan-ordered f32 value stream into segments. Traceable
         (usable inside jit / fori_loop / other kernels)."""
-        out2d = _windowed_segsum(vals, self._ids2d, self._wb,
-                                 rows_pad=self.rows_pad,
-                                 nsteps=self.nsteps,
-                                 outblk=self.outblk, sub=self.SUB)
+        from ..kernels.segment import windowed_segsum
+
+        out2d = windowed_segsum(vals, self._ids2d, self._wb,
+                                rows_pad=self.rows_pad,
+                                nsteps=self.nsteps,
+                                outblk=self.outblk, sub=self.SUB)
         return out2d.reshape(-1)[:self.num_segments]
 
 
 def _windowed_segsum(vals: jax.Array, ids2d: jax.Array, wb: jax.Array,
-                     *, rows_pad: int, nsteps: int, outblk: int,
-                     sub: int) -> jax.Array:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+                     **kw) -> jax.Array:
+    """Back-compat alias (array/sparse.py, examples/pagerank.py): the
+    kernel proper moved to spartan_tpu/kernels/segment.py."""
+    from ..kernels.segment import windowed_segsum
 
-    nout = rows_pad // outblk
-    vals2d = vals.astype(jnp.float32).reshape(-1, 128)
-    # flush runs on dedicated trailing grid steps AFTER all accumulation
-    # steps: every output block is flushed (including a trailing partial
-    # one — rows_pad is padded to outblk), and no entry can arrive after
-    # its block was written out, regardless of id skew
-    grid = nsteps + nout
-
-    def kernel(wb_ref, ids_ref, vals_ref, out_ref, scratch):
-        b = pl.program_id(0)
-
-        @pl.when(b == 0)
-        def _init():
-            scratch[:] = jnp.zeros_like(scratch)
-
-        @pl.when(b < nsteps)
-        def _accumulate():
-            lane_iota = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
-            sub_iota = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
-            for j in range(sub):
-                acc = jnp.zeros((8, 128), jnp.float32)
-                for s in range(8):
-                    ids_s = ids_ref[j * 8 + s, :]
-                    lo = ids_s & 127
-                    hi = ids_s >> 7
-                    # entries live on lanes in both one-hots: no relayouts
-                    a = (jnp.broadcast_to(lo[None, :], (128, 128))
-                         == lane_iota).astype(jnp.float32)   # (lane, entry)
-                    bmat = (jnp.broadcast_to(hi[None, :], (8, 128))
-                            == sub_iota).astype(jnp.float32)  # (subrow, e)
-                    bmat = bmat * vals_ref[j * 8 + s, :][None, :]
-                    acc = acc + jax.lax.dot_general(
-                        bmat, a, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                        precision=jax.lax.Precision.HIGHEST)
-                w = wb_ref[b * sub + j]
-                scratch[pl.ds(w * 8, 8), :] += acc
-
-        @pl.when(b >= nsteps)
-        def _flush():
-            k = jnp.maximum(b - nsteps, 0)
-            out_ref[:] = scratch[pl.ds(k * outblk, outblk), :]
-
-    def in_map(b, wb_ref):
-        return (jnp.minimum(b, nsteps - 1), 0)
-
-    f = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(grid,),
-            in_specs=[
-                pl.BlockSpec((sub * 8, 128), in_map),
-                pl.BlockSpec((sub * 8, 128), in_map),
-            ],
-            out_specs=pl.BlockSpec(
-                (outblk, 128),
-                lambda b, wb_ref: (jnp.maximum(b - nsteps, 0), 0)),
-            scratch_shapes=[pltpu.VMEM((rows_pad, 128), jnp.float32)],
-        ),
-        out_shape=jax.ShapeDtypeStruct((rows_pad, 128), jnp.float32),
-        interpret=not _pallas_available(),
-    )
-    return f(wb, ids2d, vals2d)
-
-
-def segment_sum(vals: jax.Array, ids: jax.Array, num_segments: int,
-                impl: Optional[str] = None,
-                sorted_ids: bool = False) -> jax.Array:
-    """Sum ``vals`` rows into ``num_segments`` buckets by ``ids``.
-
-    ids outside [0, num_segments) are dropped (XLA segment_sum
-    semantics), which the padding paths rely on. ``sorted_ids`` unlocks
-    XLA's sorted-scatter fast path (the SparseDistArray invariant)."""
-    impl = impl or FLAGS.segment_impl
-    if impl == "auto":
-        # measured on v5e (1M x 128, k=64): xla scatter 33ms,
-        # onehot 67ms, pallas 71ms (highest-precision merges) — XLA's
-        # native scatter wins; the matmul paths stay as ablations
-        impl = "xla"
-    if impl == "pallas":
-        if not _pallas_available():
-            impl = "onehot"
-        else:
-            return _segment_sum_pallas(vals, ids, num_segments)
-    if impl == "onehot":
-        return _segment_sum_onehot(vals, ids, num_segments)
-    return _segment_sum_xla(vals, ids, num_segments, sorted_ids)
-
-
-def segment_count(ids: jax.Array, num_segments: int,
-                  dtype=jnp.float32, impl: Optional[str] = None
-                  ) -> jax.Array:
-    return segment_sum(jnp.ones(ids.shape, dtype), ids, num_segments, impl)
+    return windowed_segsum(vals, ids2d, wb, **kw)

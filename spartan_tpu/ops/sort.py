@@ -52,7 +52,8 @@ _SAMPLES = 64  # per-shard splitter samples (capped at shard size)
 
 
 def _kernel(xs: jax.Array, axis, p: int, s: int, n: int,
-            with_indices: bool = False, ragged: bool = False):
+            with_indices: bool = False, ragged: bool = False,
+            pack_sel=None):
     """One shard's sample sort over its ``m``-slot row of the padded
     array; ``n`` is the true (unpadded) global length, so slots with
     global index >= n form the validity channel. With ``with_indices``
@@ -125,6 +126,25 @@ def _kernel(xs: jax.Array, axis, p: int, s: int, n: int,
                                    out_off, rsizes)
         else:
             ridx = None
+    elif pack_sel is not None:
+        # kernel-layer pack (spartan_tpu/kernels/exchange.py): bucket
+        # runs are contiguous in the sorted stream, so the send buffer
+        # is a batch of dynamic slices — the Pallas kernel replaces
+        # the XLA scatter this branch used to lower through. Validity
+        # is an iota compare: row j holds counts[j] leading slots.
+        from ..kernels import exchange as kexchange
+
+        send = kexchange.partition_pack(xs_sorted, starts, counts, p,
+                                        pack_sel)
+        vals = exchange(send).ravel()
+        valid_send = (jnp.arange(m, dtype=jnp.int32)[None, :]
+                      < counts[:, None]).astype(jnp.int32)
+        rvalid = exchange(valid_send)
+        valid_key = (1 - rvalid).ravel()
+        k = jnp.sum(rvalid)
+        ridx = (exchange(kexchange.partition_pack(
+            src_idx, starts, counts, p, pack_sel)).ravel()
+            if with_indices else None)
     else:
         pos = jnp.arange(m, dtype=jnp.int32) - starts[
             jnp.minimum(dst, p - 1)]
@@ -260,10 +280,22 @@ def _run(x: jax.Array, mesh, with_indices: bool,
     # batching rule for ragged_all_to_all)
     ragged = (x.ndim == 1
               and next(iter(mesh.devices.flat)).platform == "tpu")
+    pack_sel = None
+    if not ragged:
+        # padded transport: the kernel layer may pack the send buffer
+        # with the Pallas dynamic-slice kernel instead of XLA scatter
+        # (batched sorts vmap it — pallas_call carries the batch as an
+        # extra grid dim). 1-D TPU sorts never get here: the ragged
+        # transport already moves payload-only bytes.
+        from ..kernels import registry as kernels_mod
+
+        sel = kernels_mod.select("sort_exchange", (n,), x.dtype, t,
+                                 mesh, p=p, m=m)
+        pack_sel = sel if sel.pallas else None
 
     def row_fn(r):
         out = _kernel(r, name, p, s, n, with_indices=with_indices,
-                      ragged=ragged)
+                      ragged=ragged, pack_sel=pack_sel)
         return out[1] if with_indices else out
 
     def block_fn(v):  # local block: batch axes (locally) whole
@@ -272,8 +304,12 @@ def _run(x: jax.Array, mesh, with_indices: bool,
         rows = v.reshape((-1, m))
         return jax.vmap(row_fn)(rows).reshape(v.shape[:-1] + (m,))
 
+    # the replication checker has no rule for pallas_call; only the
+    # kernel-packed variant relaxes it, so the GSPMD lowering stays
+    # byte-identical with the kernel layer off
+    kw = {"check_rep": False} if pack_sel is not None else {}
     mapped = shard_map(block_fn, mesh=mesh,
-                       in_specs=(t.spec(),), out_specs=t.spec())
+                       in_specs=(t.spec(),), out_specs=t.spec(), **kw)
     out = mapped(xp)
     return out[..., :n] if m * p != n else out
 
@@ -348,6 +384,15 @@ def distributed_topk(x: jax.Array, k: int, largest: bool = True,
     row = tiling_mod.row(1)
     xp = redist_mod.constrain(xp, row, mesh)
     sentinel = _extreme(x.dtype, lo=largest)
+    # kernel-layer per-shard selection (spartan_tpu/kernels/topk.py):
+    # replaces the local lax.top_k (a full sort on TPU) with the
+    # streaming extraction kernel; the candidate gather + final merge
+    # stay identical, so the sentinel/tie-break invariant below holds
+    # for both backends (the kernel ties toward the LOWER index too)
+    from ..kernels import registry as kernels_mod
+
+    topk_sel = kernels_mod.select("topk", (n,), x.dtype, row, mesh,
+                                  k=k)
 
     def kern(xs):
         me = jax.lax.axis_index(axis)
@@ -370,7 +415,14 @@ def distributed_topk(x: jax.Array, k: int, largest: bool = True,
         # >= k valid slots since k <= m <= n, so the k winners always
         # exist among valid candidates.) Tested with sentinel-extreme
         # data on a ragged last shard in tests/test_sort.py.
-        lk, li = jax.lax.top_k(key, k)
+        if topk_sel.pallas:
+            from ..kernels import topk as ktopk
+
+            lk, li = ktopk.shard_topk(key, k, _extreme(key.dtype,
+                                                       lo=True),
+                                      topk_sel)
+        else:
+            lk, li = jax.lax.top_k(key, k)
         lv = vv[li]
         gk = jax.lax.all_gather(lk, axis, tiled=True)       # (p*k,)
         gv = jax.lax.all_gather(lv, axis, tiled=True)
@@ -378,9 +430,10 @@ def distributed_topk(x: jax.Array, k: int, largest: bool = True,
         _, win = jax.lax.top_k(gk, k)
         return gv[win][None], gi[win][None].astype(jnp.int32)
 
+    kw = {"check_rep": False} if topk_sel.pallas else {}
     mapped = shard_map(
         kern, mesh=mesh, in_specs=(row.spec(),),
-        out_specs=(tiling_mod.Tiling((axis, None)).spec(),) * 2)
+        out_specs=(tiling_mod.Tiling((axis, None)).spec(),) * 2, **kw)
     vals, idx = mapped(xp)
     # every shard computed the same winners: shard 0's row is the answer
     return vals[0], idx[0]

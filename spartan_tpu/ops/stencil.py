@@ -3,19 +3,24 @@ SURVEY.md §2.3: convnet stencil/maxpool in some reference versions).
 
 TPU-native: the stencil is ``lax.conv_general_dilated`` (MXU) and pooling
 is ``lax.reduce_window`` (VPU), traced into the consuming jit like any
-map — no halo-exchange bookkeeping, GSPMD partitions spatial dims with
-halo transfers when the inputs are sharded.
+map. :func:`stencil` now lowers through a dedicated :class:`StencilExpr`
+node: when the committed tiling shards the H axis, the kernel layer
+(``spartan_tpu/kernels/stencil.py``, docs/KERNELS.md) replaces GSPMD's
+generic halo collectives with an explicit ``ppermute`` halo exchange
+feeding a blocked Pallas conv kernel; every other case (stride > 1,
+non-SAME padding, unsharded spatial dims, non-f32) keeps the traced
+conv, where GSPMD partitions spatial dims with its own halo transfers.
 """
 
 from __future__ import annotations
 
-from typing import Tuple, Union
+from typing import Any, Dict, Tuple, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ..expr.base import Expr, as_expr
+from ..array.tiling import Tiling
+from ..expr.base import Expr, as_expr, eval_shape_of
 from ..expr.map2 import map2
 
 Stride = Union[int, Tuple[int, int]]
@@ -25,19 +30,67 @@ def _pair(v: Stride) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+class StencilExpr(Expr):
+    """NHWC convolution with a kernel-layer lowering seam.
+
+    ``kernels.select('stencil', ...)`` decides per shape/tiling/
+    platform whether this node runs the manual-halo Pallas path or the
+    traced ``lax.conv`` (GSPMD halos); ``st.explain`` prints the
+    decision and the derived grid for the plan (docs/KERNELS.md)."""
+
+    def __init__(self, x: Expr, w: Expr, stride: Tuple[int, int],
+                 padding: str):
+        self.x = x
+        self.w = w
+        self.stride = tuple(int(s) for s in stride)
+        self.padding = str(padding)
+        out = eval_shape_of(
+            lambda xv, wv: self._conv(xv, wv), x, w,
+            cache_key=("stencil", self.stride, self.padding))
+        super().__init__(out.shape, out.dtype)
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.x, self.w)
+
+    def replace_children(self, new_children) -> "StencilExpr":
+        return StencilExpr(new_children[0], new_children[1],
+                           self.stride, self.padding)
+
+    def _conv(self, xv: Any, wv: Any) -> Any:
+        return jax.lax.conv_general_dilated(
+            xv, wv, window_strides=self.stride, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def _lower(self, env: Dict[int, Any]) -> Any:
+        from ..kernels import registry as kernels_mod
+
+        xv = self.x.lower(env)
+        wv = self.w.lower(env)
+        sel = kernels_mod.node_selection(self)
+        if sel is not None and sel.pallas:
+            from ..kernels import stencil as kstencil
+
+            return kstencil.halo_stencil(xv, wv, self.x.out_tiling(),
+                                         sel)
+        return self._conv(xv, wv)
+
+    def _sig(self, ctx) -> Tuple:
+        return ("stencil", self.stride, self.padding,
+                ctx.of(self.x), ctx.of(self.w))
+
+    def _default_tiling(self) -> Tiling:
+        # batch/H shardings carry through (the halo path preserves
+        # them); the W window and output channels stay whole. The plan
+        # sanitizes H away when the output height stops dividing.
+        tx = self.x.out_tiling()
+        return Tiling((tx.axes[0], tx.axes[1], None, None))
+
+
 def stencil(images, filters, stride: Stride = 1,
             padding: str = "SAME") -> Expr:
     """images (N, H, W, C), filters (KH, KW, C, O) -> (N, H', W', O)."""
-    images = as_expr(images)
-    filters = as_expr(filters)
-    s = _pair(stride)
-
-    def kern(x, w):
-        return jax.lax.conv_general_dilated(
-            x, w, window_strides=s, padding=padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-
-    return map2([images, filters], kern)
+    return StencilExpr(as_expr(images), as_expr(filters),
+                       _pair(stride), padding)
 
 
 def maxpool(images, window: Stride = 2, stride: Stride = None,

@@ -414,3 +414,33 @@ def test_raw_sharding_constraint_allowed_in_owners():
     other = ast.parse("name = 'with_sharding_constraint'\n"
                       "fn = redistribute.constrain\n")
     assert lint_repo.lint_sharding_constraints("/x/y.py", other) == []
+
+
+def test_catches_pallas_outside_kernels(tmp_path):
+    bad = tmp_path / "bad_pallas.py"
+    bad.write_text(
+        "from jax.experimental import pallas as pl\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "import jax.experimental.pallas as p2\n"
+        "out = pl.pallas_call(kern, out_shape=shape)(x)\n"
+        "mod = jax.experimental.pallas\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_pallas_imports(str(bad), tree)
+    assert sum(f.rule == "pallas-outside-kernels"
+               for f in findings) == 5
+    assert all("spartan_tpu/kernels/" in f.message for f in findings)
+
+
+def test_pallas_allowed_in_kernel_layer():
+    tree = ast.parse(
+        "from jax.experimental import pallas as pl\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "out = pl.pallas_call(kern, out_shape=shape)(x)\n")
+    for rel in (os.path.join("spartan_tpu", "kernels", "segment.py"),
+                os.path.join("spartan_tpu", "kernels", "topk.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_pallas_imports(path, tree) == []
+    # a Selection.pallas property read is NOT the pallas module
+    other = ast.parse("if sel.pallas:\n    pass\n"
+                      "name = 'pallas_call'\n")
+    assert lint_repo.lint_pallas_imports("/x/y.py", other) == []

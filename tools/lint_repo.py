@@ -222,6 +222,15 @@ _WSC_ALLOWED_FILES = {
 _MESH_MAKERS = {"get_mesh", "build_mesh", "rebuild_mesh", "Mesh"}
 _MESH_ALLOWED_DIRS = (os.path.join("spartan_tpu", "parallel") + os.sep,)
 
+# rule 12: Pallas is the kernel layer's private dependency. A raw
+# pallas_call outside spartan_tpu/kernels/ bypasses the selection
+# policy (kernels.select), the tiling->grid derivation, the
+# plan/compile-key separation and the interpret-mode parity contract
+# (docs/KERNELS.md) — exactly the single-device dead ends the seed's
+# ops/kmeans.py and ops/segment.py kernels were.
+_PALLAS_ALLOWED_DIRS = (os.path.join("spartan_tpu", "kernels")
+                        + os.sep,)
+
 
 class Finding:
     def __init__(self, path: str, line: int, rule: str, message: str):
@@ -667,6 +676,52 @@ def lint_sharding_constraints(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_pallas_imports(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 12: no ``jax.experimental.pallas`` import (or
+    ``pallas_call`` use) outside ``spartan_tpu/kernels/`` — every
+    Pallas kernel goes through the kernel layer so its grid derives
+    from the committed tiling and its backend choice is keyed,
+    selectable and explainable."""
+    rel = os.path.relpath(path, REPO)
+    if any(rel.startswith(d) for d in _PALLAS_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "pallas-outside-kernels",
+            f"{what}: Pallas kernels live in spartan_tpu/kernels/ "
+            "(docs/KERNELS.md) — add the kernel there, derive its "
+            "grid from the committed Tiling (kernels.registry.derive) "
+            "and route callers through kernels.select"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "pallas" in mod.split("."):
+                flag(node, f"import from {mod!r}")
+            elif any(a.name == "pallas" or a.name.startswith("pallas.")
+                     for a in node.names):
+                flag(node, "binds the pallas module")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if "pallas" in a.name.split("."):
+                    flag(node, f"import {a.name}")
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "pallas_call":
+            # pl.pallas_call / pallas.pallas_call — the call seam
+            flag(node, "pallas_call use")
+        elif isinstance(node, ast.Attribute) and node.attr == "pallas":
+            # jax.experimental.pallas attribute chains (not arbitrary
+            # objects with a .pallas property, e.g. kernels.Selection)
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "jax":
+                flag(node, "attribute access on jax's pallas")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -757,6 +812,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_raw_profiling(path, tree))
         findings.extend(lint_named_scopes(path, tree))
         findings.extend(lint_sharding_constraints(path, tree))
+        findings.extend(lint_pallas_imports(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
