@@ -48,6 +48,14 @@ KM_N, KM_D, KM_K, KM_ITERS = 1_000_000, 128, 64, 20
 # measured ~110 s there); K=512 is the headline measurement.  Timeboxes
 # are generous for first-compile (~20-40 s) + tunnel round trips.
 STAGES = [(1, 1, 420), (512, 3, 600)]
+# Fail-fast probe (the r05 lesson, docs/BENCH.md "r04 -> r05 verdict"):
+# r05 burned BOTH the 420 s and 600 s timeboxes discovering that the
+# experimental 'axon' platform could not finish a single jit — the
+# probe spends at most this long proving the default platform can
+# compile + run + fetch a trivial jit before any real timebox starts;
+# a dead platform now costs ~90 s and a recorded diagnosis instead of
+# 17 minutes of silence.
+STAGE_PROBE_TIMEOUT = 90
 # HIGHEST-precision stage: ~6 f32 passes per MXU matmul, so a shorter
 # chain keeps the stage a few seconds of device time.
 STAGE_HIGHEST = (64, 3, 420)
@@ -189,6 +197,40 @@ def _plan_diag() -> dict:
     return {"hits": stats["plan_hits"], "misses": stats["plan_misses"],
             "compiles": stats["compiles"], "phase_ms": phases,
             "phase_p95_ms": p95_ms}
+
+
+def worker_probe() -> None:
+    """Tiny jit probe on the default platform: device enumeration ->
+    compile -> run -> fetch of a 256x256 dot, each a phase the axon
+    class of failure can hang in. Prints one JSON line with per-phase
+    seconds so a timeout's LAST line (if any) names the phase that
+    died; the parent grades ok/timeout and falls back to CPU without
+    burning the real 420/600 s timeboxes."""
+    import numpy as np
+
+    phases = {}
+    t0 = time.perf_counter()
+    jax = _fix_platform()
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform  # may hang: first PJRT probe
+    phases["init_s"] = round(time.perf_counter() - t0, 3)
+    print(f"[probe] devices ok: {platform}", file=sys.stderr, flush=True)
+    a = jnp.asarray(np.random.RandomState(0).rand(256, 256)
+                    .astype(np.float32))
+    t1 = time.perf_counter()
+    f = jax.jit(lambda x: (x @ x).sum())
+    out = f(a)
+    out.block_until_ready()
+    phases["compile_run_s"] = round(time.perf_counter() - t1, 3)
+    t2 = time.perf_counter()
+    val = float(out)
+    phases["fetch_s"] = round(time.perf_counter() - t2, 3)
+    assert np.isfinite(val)
+    print(json.dumps({
+        "metric": "jit_probe", "probe": "ok", "platform": platform,
+        "seconds": round(time.perf_counter() - t0, 3), **phases,
+    }), flush=True)
 
 
 def worker_dot(k: int, reps: int, precision: str | None) -> None:
@@ -532,7 +574,33 @@ def _ok_diag(stage_name, stage):
 def main() -> None:
     result = None
     diags = []
-    for k, reps, timeout in STAGES:
+    # fail-fast probe: prove the default platform can finish ONE tiny
+    # jit inside a short timebox before committing the 420/600 s
+    # stages to it. On probe death the dot stages are skipped entirely
+    # (result stays None -> the existing CPU fallback path runs) with
+    # the probe's diagnosis in stage_diags.
+    probe_dead = False
+    t0 = time.perf_counter()
+    out, err, rc = _run_stage("--worker-probe", [], STAGE_PROBE_TIMEOUT)
+    probe = _parse_stage(out)
+    if rc is None or probe is None or probe.get("probe") != "ok":
+        probe_dead = True
+        reason = (f"killed after {STAGE_PROBE_TIMEOUT}s timeout"
+                  if rc is None else "no JSON output")
+        diags.append(_diag(
+            "probe", reason, rc=rc, err=err,
+            note="default platform failed the tiny-jit probe; "
+                 "skipping the dot timeboxes, falling back to CPU"))
+        print(f"[bench] jit probe failed ({reason}); skipping default-"
+              "platform stages", file=sys.stderr)
+    else:
+        diags.append({"stage": "probe", "reason": "ok", **{
+            k: probe[k] for k in ("platform", "seconds", "init_s",
+                                  "compile_run_s", "fetch_s")
+            if k in probe}})
+        print(f"[bench] jit probe ok on {probe.get('platform')} in "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    for k, reps, timeout in (() if probe_dead else STAGES):
         if result is not None:
             # Skip a refinement stage that cannot finish in its timebox
             # (e.g. K=512 on a CPU fallback): predict from the measured
@@ -756,7 +824,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 5 and sys.argv[1] == "--worker-dot":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker-probe":
+        worker_probe()
+    elif len(sys.argv) >= 5 and sys.argv[1] == "--worker-dot":
         prec = None if sys.argv[4] == "default" else sys.argv[4]
         worker_dot(int(sys.argv[2]), int(sys.argv[3]), prec)
     elif len(sys.argv) >= 4 and sys.argv[1] == "--worker-kmeans":

@@ -308,6 +308,24 @@ def native_overhead(st):
     return nv.measure(iters=60, n=4096, reps=3)
 
 
+def warmstart_overhead(st):
+    """Warm-start layer gates (benchmarks/warm_start.py): the
+    persist layer's off-path toll on the steady-state hit path (<=1%
+    is the ISSUE-13 gate; with persist_cache_dir unset, hits never
+    touch the layer and the miss path pays one flag read) plus the
+    process-restart harness — a fresh child process against the
+    populated store must serve the plan set with ZERO recompiles and
+    bit-equal results (warm_recompiles / warm_restart_bit_equal ride
+    the record; cold/warm time-to-first-result is the fleet-story
+    number)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import warm_start as ws
+
+    if SMALL:
+        return ws.measure(iters=40, n=512, restart_n=128)
+    return ws.measure()
+
+
 def serving_overhead(st):
     """Serving-engine gates (benchmarks/serving_latency.py): 16-client
     coalesced throughput vs a serial evaluate() loop (>=3x is the
@@ -406,6 +424,9 @@ def guard_metrics(report) -> dict:
         "kernels_off_overhead_ratio":
             report["native_overhead"].get(
                 "kernels_off_overhead_ratio"),
+        "warmstart_off_overhead_ratio":
+            report["warmstart_overhead"].get(
+                "warmstart_off_overhead_ratio"),
         # per-op pallas-vs-gspmd floors: judged on TPU only (the CPU
         # native arm is interpret-mode parity evidence — no cpu
         # thresholds are committed for these)
@@ -454,6 +475,7 @@ def main():
             redistribution_overhead, st),
         "profile_overhead": _with_metrics(profile_overhead, st),
         "native_overhead": _with_metrics(native_overhead, st),
+        "warmstart_overhead": _with_metrics(warmstart_overhead, st),
     }
     # full flag state once at report level (the per-record
     # flags_nondefault deltas are diffs against these defaults)
@@ -492,7 +514,8 @@ def main():
                  "calibration_off_overhead_ratio": 0.01,
                  "redist_off_overhead_ratio": 0.01,
                  "profile_off_overhead_ratio": 0.01,
-                 "kernels_off_overhead_ratio": 0.01}
+                 "kernels_off_overhead_ratio": 0.01,
+                 "warmstart_off_overhead_ratio": 0.01}
         # fixed FLOORS (ISSUE gates on ratios that must stay high):
         # coalescing must amortize dispatch >=3x across 16 clients;
         # a Pallas kernel keeps its slot only while it beats (kmeans)

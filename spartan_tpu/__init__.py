@@ -42,6 +42,7 @@ from .resilience import ChaosPlan, FatalMeshError, chaos, chaos_clear
 from . import serve
 from .serve import (Backpressure, DeadlineExceeded, EvalFuture,
                     MeshReconfiguring, ServeEngine, evaluate_async)
+from . import persist
 from .utils import checkpoint, profiling
 from .utils.config import FLAGS
 
@@ -54,7 +55,7 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "rebuild_mesh", "mesh_epoch", "StaleMeshError",
             "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
             "check", "lint",
-            "obs", "explain", "ExplainReport", "metrics", "trace_export",
+            "obs", "persist", "explain", "ExplainReport", "metrics", "trace_export",
             "trace_events", "trace_clear",
             "ledger", "flightrec", "CalibrationProfile", "fit_profile",
             "save_profile", "load_profile",

@@ -36,6 +36,7 @@ from ..obs import profile as profile_mod
 from ..obs.explain import build_plan_report, key_hash
 from ..parallel import mesh as mesh_mod
 from ..parallel import redistribute as redistribute_mod
+from .. import persist as persist_mod
 from ..resilience import degrade as degrade_mod
 from ..resilience import faults as faults_mod
 from ..resilience import memory as memory_mod
@@ -727,7 +728,8 @@ class _Plan:
     # references so st.ledger(validate=True) can run the memory
     # validation for live plans without pinning evicted ones
     __slots__ = ("key", "traced", "out_tilings", "is_tuple", "arg_order",
-                 "report", "governed_rung", "__weakref__")
+                 "report", "governed_rung", "persist_digest",
+                 "__weakref__")
 
     def __init__(self, key: Tuple, traced: Callable,
                  out_tilings: Tuple[Tiling, ...], is_tuple: bool,
@@ -744,6 +746,11 @@ class _Plan:
         # to the named ladder rung instead of dispatching a doomed
         # executable. One attribute read per cache hit when ungoverned.
         self.governed_rung: Optional[str] = None
+        # on-disk address in the warm-start store (spartan_tpu/persist)
+        # when FLAGS.persist_cache_dir is set and the plan key has a
+        # process-stable digest; None otherwise (one attribute read on
+        # the first-compile path decides whether to persist)
+        self.persist_digest: Optional[str] = None
 
 
 class _Exec:
@@ -975,6 +982,11 @@ def evict_stale_plans() -> int:
             del _compile_cache[ck]
     if evicted:
         prof.count("plan_evictions", evicted)
+    # the on-disk half (spartan_tpu/persist): purge persisted entries
+    # of dead mesh epochs too — without this a process restart would
+    # resurrect plans for a mesh that no longer exists. No-op (one
+    # flag read) with the store off; never raises.
+    persist_mod.evict_stale()
     return evicted
 
 
@@ -1220,10 +1232,19 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         args, darrs, dpos = _gather_args(leaves, order, donated)
         donate_key = frozenset(dpos)
 
-    ex = cached_executable(
-        plan.key + (donate_key,),
-        lambda: (jax.jit(plan.traced, donate_argnums=tuple(sorted(dpos)))
-                 if dpos else jax.jit(plan.traced)))
+    def _make() -> Callable:
+        if dpos:
+            return jax.jit(plan.traced,
+                           donate_argnums=tuple(sorted(dpos)))
+        if plan.persist_digest is not None \
+                and persist_mod.active() is not None:
+            # warm-start store active: build the base variant AOT so
+            # the SAME compile is both dispatchable and serializable
+            # (persistence never pays a second XLA compile)
+            return persist_mod.aot_compile(plan.traced, args)
+        return jax.jit(plan.traced)
+
+    ex = cached_executable(plan.key + (donate_key,), _make)
 
     def run() -> Any:
         with warnings.catch_warnings():
@@ -1255,6 +1276,11 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         if dpos:
             dsp.set(donated=sorted(dpos))
     ex.warm = True
+    if fresh and not dpos and plan.persist_digest is not None:
+        # first compile of a persistable plan: serialize + store it
+        # (atomic, lease-arbitrated, no-raise — a failed persist never
+        # fails the evaluation that produced the plan)
+        persist_mod.maybe_store(plan, ex.jitted, mesh)
     if ledger_mod._LEDGER_FLAG._value and plan.report is not None:
         # cost ledger: the measured wall time of this run, next to the
         # plan's predicted tiling-DP cost (one flag read when off)
@@ -1414,6 +1440,17 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
     result and there is nothing to compile."""
     from .optimize import optimize
 
+    # warm-start store consult (spartan_tpu/persist): BEFORE the
+    # optimizer runs, probe the on-disk store for this raw signature +
+    # environment fingerprint. A hit skips the XLA compile below (the
+    # deserialized executable is pre-seeded into the compile cache); a
+    # rejected entry (corrupt / stale / foreign / io fault) degrades
+    # to this normal recompile with the reason on the plan report.
+    # One flag read when the store is off.
+    p_entry = p_digest = p_reason = None
+    if rctx is not None and plan_key is not None:
+        p_entry, p_digest, p_reason = persist_mod.lookup(plan_key, mesh)
+
     passes_report: List[Dict[str, Any]] = []
     with prof.phase("optimize"):
         dag = optimize(expr, report=passes_report)
@@ -1502,11 +1539,44 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
                                                       mesh)
     plan = _Plan(key, traced, out_tilings, is_tuple, identity, report)
 
+    if p_digest is not None or p_reason is not None:
+        # persist outcome onto the report (st.explain names disk-hit
+        # vs compile; the serve worker stamps it onto flight records)
+        rec: Dict[str, Any] = {"source": "compile", "digest": p_digest}
+        if p_reason:
+            rec["reason"] = p_reason
+        if p_entry is not None:
+            if p_entry.matches(out_tilings, is_tuple, raw_order,
+                               len(raw_order or ())):
+                # pre-seed the compile cache with the restored AOT
+                # executable under the base (no-donation) variant key:
+                # the dispatch below finds it warm — ZERO recompiles.
+                # A call-time aval/sharding mismatch inside the guard
+                # degrades to a fresh jit of the traced fn just built.
+                ex = _Exec(persist_mod.guarded_callable(
+                    p_entry, lambda: jax.jit(traced)))
+                ex.warm = True
+                with _cache_lock:
+                    _compile_cache.setdefault(key + (frozenset(),), ex)
+                persist_mod.note_hit()
+                rec = {"source": "disk", "digest": p_digest}
+            else:
+                persist_mod.reject_entry(p_entry, "meta_mismatch")
+                rec["reason"] = "meta_mismatch"
+        if raw_order is not None and p_digest is not None:
+            plan.persist_digest = p_digest
+        report["persist"] = rec
+        persist_mod.note_build(rec["source"], p_digest,
+                               rec.get("reason"))
+
     ledger_plan = plan
     if rctx is not None and plan_key is not None:
         if raw_order is not None:
             stored = _Plan(key, traced, out_tilings, is_tuple, raw_order,
                            report)
+            # hits dispatch the stored plan: it must carry the same
+            # on-disk address so a later recompile re-persists
+            stored.persist_digest = plan.persist_digest
             # the winner of a store race is what later lookups (and
             # st.ledger's validation) see — ledger the same object
             ledger_plan = store_plan(plan_key, stored)

@@ -368,6 +368,23 @@ class ExplainReport:
                 if kn.get("reason"):
                     line += f" ({kn['reason']})"
                 lines.append(line)
+        pz = d.get("persist")
+        if pz:
+            # warm-start provenance (spartan_tpu/persist): whether the
+            # executable was restored from the on-disk store or
+            # compiled here — and, for a compile, why a store entry
+            # was not usable (corrupt / stale / version skew / io)
+            if pz.get("source") == "disk":
+                line = "  persist: disk hit"
+            else:
+                line = "  persist: compiled"
+                if pz.get("stored"):
+                    line += ", stored to cache dir"
+            if pz.get("digest"):
+                line += f" (entry {str(pz['digest'])[:12]})"
+            if pz.get("reason"):
+                line += f" [fallback: {pz['reason']}]"
+            lines.append(line)
         if d.get("reshard_edges"):
             lines.append("  reshard edges:")
             for e in d["reshard_edges"]:

@@ -1,0 +1,357 @@
+"""Crash-safe on-disk store for plans and compiled executables.
+
+Layout (``FLAGS.persist_cache_dir``; docs/WARMSTART.md)::
+
+    <dir>/
+      entry_<digest>/            one persisted plan
+        manifest.json            version + fingerprint + per-file CRC32
+        plan.json                plan metadata (out tilings, arg order)
+        trees.pkl                pickled (in_tree, out_tree) PyTreeDefs
+        exec.bin                 serialized XLA executable (jax AOT)
+      entry_<digest>.tmp-<pid>/  in-flight write (atomically promoted)
+      entry_<digest>.lease       writer lease (multi-process arbitration)
+
+Write discipline is the PR-5 checkpoint contract: every file lands in
+a temp dir next to the final path, the manifest (carrying a CRC32 per
+sibling file) is written LAST inside the temp dir, and one
+``os.replace`` promotes the whole entry — a reader or a crash can only
+ever observe a complete entry or none.
+
+Concurrency is lock-free-reader / lease-writer: readers never take any
+lock (atomic promotion means they see old-or-new, and the CRC manifest
+catches torn bytes from a non-atomic filesystem); a writer first
+creates ``entry_<digest>.lease`` with ``O_EXCL`` — losing the race
+means another replica is persisting the same entry, and this writer
+simply skips (the winner's entry is equivalent). Stale leases (older
+than ``FLAGS.persist_lease_ttl_s`` — a writer crashed mid-persist)
+are broken.
+
+EVERY failure mode — missing entry, truncated or corrupt file (CRC
+named), version or fingerprint skew, pickle/deserialize errors, an
+``io`` chaos fault — surfaces as a :class:`PersistRejected` (or plain
+``OSError``) that the :mod:`spartan_tpu.persist` wrapper converts into
+"recompile normally": persistence can never make ``evaluate()`` less
+available than it is with the store off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.config import FLAGS
+from ..utils.log import log_debug, log_warn
+from .fingerprint import FORMAT_VERSION
+
+_MANIFEST = "manifest.json"
+_PLAN = "plan.json"
+_TREES = "trees.pkl"
+_EXEC = "exec.bin"
+
+
+class PersistRejected(RuntimeError):
+    """A store entry was rejected (corrupt / stale / foreign); carries
+    the machine-readable ``reason`` surfaced in metrics + st.explain."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+def _fire_io_fault() -> None:
+    """Chaos seam: ``io`` tokens (resilience/faults.py) fire on the
+    persist load AND store paths, sharing the checkpoint site's
+    occurrence counter — one module-attribute read when chaos is
+    off."""
+    from ..resilience import faults as _faults
+
+    if _faults._ACTIVE is not None:
+        _faults.fire("checkpoint")
+
+
+def _axes_to_json(axes: Tuple) -> List[Any]:
+    return [list(a) if isinstance(a, tuple) else a for a in axes]
+
+
+class Entry:
+    """One restored store entry: the deserialized executable plus the
+    plan metadata ``expr.base._build_plan`` validates before
+    pre-seeding the compile cache."""
+
+    __slots__ = ("digest", "compiled", "out_tilings_json", "is_tuple",
+                 "arg_order", "nargs")
+
+    def __init__(self, digest: str, compiled: Any, plan_meta: Dict[str, Any]):
+        self.digest = digest
+        self.compiled = compiled
+        self.out_tilings_json = plan_meta["out_tilings"]
+        self.is_tuple = bool(plan_meta["is_tuple"])
+        ao = plan_meta["arg_order"]
+        self.arg_order = tuple(int(i) for i in ao) if ao is not None else None
+        self.nargs = int(plan_meta["nargs"])
+
+    def matches(self, out_tilings, is_tuple: bool,
+                arg_order: Optional[Tuple[int, ...]], nargs: int) -> bool:
+        """Belt check next to the digest + fingerprint: the plan this
+        process just derived must agree with the persisted metadata
+        before the executable is trusted."""
+        return (self.out_tilings_json == [_axes_to_json(t.axes)
+                                          for t in out_tilings]
+                and self.is_tuple == is_tuple
+                and self.arg_order == arg_order
+                and self.nargs == nargs)
+
+
+class PersistStore:
+    """One process's handle on a (possibly shared) cache directory."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        # prewarm's in-memory table (serve startup): digest -> Entry,
+        # consulted before disk so the request path pays no IO /
+        # deserialize for prewarmed plans
+        self._preloaded: Dict[str, Entry] = {}
+
+    # -- paths ----------------------------------------------------------
+
+    def _entry_dir(self, digest: str) -> str:
+        return os.path.join(self.root, f"entry_{digest}")
+
+    def has(self, digest: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._entry_dir(digest), _MANIFEST))
+
+    def digests(self) -> List[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for n in sorted(names):
+            if n.startswith("entry_") and "." not in n and os.path.exists(
+                    os.path.join(self.root, n, _MANIFEST)):
+                out.append(n[len("entry_"):])
+        return out
+
+    # -- load (lock-free reader) ---------------------------------------
+
+    def _read_checked(self, edir: str, manifest: Dict[str, Any],
+                      fname: str) -> bytes:
+        rec = (manifest.get("files") or {}).get(fname)
+        if rec is None:
+            raise PersistRejected("manifest", f"no CRC record for {fname}")
+        with open(os.path.join(edir, fname), "rb") as f:
+            data = f.read()
+        if zlib.crc32(data) != int(rec.get("crc32", -1)):
+            raise PersistRejected(
+                "crc", f"{fname} failed CRC32 verification (manifest "
+                f"{rec.get('crc32')}, read {zlib.crc32(data)}): the "
+                "file is corrupt or truncated")
+        return data
+
+    def load(self, digest: str, fingerprint: Dict[str, Any],
+             prewarm_ok: bool = True) -> Optional[Entry]:
+        """Restore one entry, or None on a clean miss. Raises
+        :class:`PersistRejected` / ``OSError`` on anything hostile —
+        the caller degrades to a recompile and counts the reason."""
+        if prewarm_ok:
+            hit = self._preloaded.get(digest)
+            if hit is not None:
+                return hit
+        edir = self._entry_dir(digest)
+        mpath = os.path.join(edir, _MANIFEST)
+        if not os.path.exists(mpath):
+            return None
+        # chaos fires only when there IS an entry to read: a clean
+        # miss consumes no io occurrence, so 'io@N' specs address the
+        # N-th REAL persist/checkpoint IO deterministically
+        _fire_io_fault()
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except ValueError as e:
+            raise PersistRejected("manifest", f"unparseable: {e}")
+        if manifest.get("version") != FORMAT_VERSION:
+            raise PersistRejected(
+                "version", f"store format {manifest.get('version')} != "
+                f"{FORMAT_VERSION}")
+        if manifest.get("fingerprint") != fingerprint:
+            raise PersistRejected(
+                "fingerprint", "environment fingerprint mismatch "
+                "(jax/platform/mesh/flags changed since this entry "
+                "was written)")
+        plan_raw = self._read_checked(edir, manifest, _PLAN)
+        trees_raw = self._read_checked(edir, manifest, _TREES)
+        exec_raw = self._read_checked(edir, manifest, _EXEC)
+        try:
+            plan_meta = json.loads(plan_raw.decode())
+        except ValueError as e:
+            raise PersistRejected("meta", f"plan.json unparseable: {e}")
+        try:
+            in_tree, out_tree = pickle.loads(trees_raw)
+            from jax.experimental import serialize_executable as _se
+
+            compiled = _se.deserialize_and_load(exec_raw, in_tree,
+                                                out_tree)
+        except PersistRejected:
+            raise
+        except Exception as e:  # noqa: BLE001 - hostile bytes: any
+            # unpickle/XLA-deserialize failure is a rejected entry,
+            # never a crashed evaluate
+            raise PersistRejected(
+                "deserialize", f"{type(e).__name__}: {e}")
+        return Entry(digest, compiled, plan_meta)
+
+    # -- save (lease writer) -------------------------------------------
+
+    def _acquire_lease(self, digest: str) -> Optional[str]:
+        lease = self._entry_dir(digest) + ".lease"
+        for attempt in (0, 1):
+            try:
+                fd = os.open(lease, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "w") as f:
+                    f.write(str(os.getpid()))
+                return lease
+            except FileExistsError:
+                try:
+                    age = time.time() - os.path.getmtime(lease)
+                except OSError:
+                    continue  # vanished: retry the O_EXCL create
+                if attempt == 0 and age > FLAGS.persist_lease_ttl_s:
+                    # a writer died mid-persist: break the stale lease
+                    try:
+                        os.unlink(lease)
+                    except OSError:
+                        pass
+                    continue
+                return None  # live writer elsewhere: skip, it wins
+        return None
+
+    def save(self, digest: str, fingerprint: Dict[str, Any],
+             plan_meta: Dict[str, Any], exec_bytes: bytes,
+             trees: Tuple[Any, Any]) -> bool:
+        """Persist one entry atomically; returns True when this
+        process's write landed (False: another writer holds the lease,
+        or the entry already exists). Raises on IO failure — the
+        wrapper counts and swallows (a failed persist never fails the
+        evaluation that produced the plan)."""
+        final = self._entry_dir(digest)
+        if os.path.exists(os.path.join(final, _MANIFEST)):
+            return False  # equivalent entry already on disk
+        lease = self._acquire_lease(digest)
+        if lease is None:
+            return False
+        tmp = final + f".tmp-{os.getpid()}"
+        try:
+            _fire_io_fault()
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            blobs = {
+                _PLAN: json.dumps(plan_meta, sort_keys=True).encode(),
+                _TREES: pickle.dumps(trees),
+                _EXEC: exec_bytes,
+            }
+            files = {}
+            for fname, data in blobs.items():
+                with open(os.path.join(tmp, fname), "wb") as f:
+                    f.write(data)
+                files[fname] = {"crc32": zlib.crc32(data),
+                                "bytes": len(data)}
+            manifest = {
+                "version": FORMAT_VERSION,
+                "digest": digest,
+                "fingerprint": fingerprint,
+                "mesh_epoch": fingerprint.get("mesh_epoch", 0),
+                "created_unix": time.time(),
+                "files": files,
+            }
+            # the manifest is the commit marker: written LAST, so a
+            # promoted entry is complete by construction
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump(manifest, f)
+            if os.path.isdir(final):  # raced a non-leased writer
+                shutil.rmtree(tmp, ignore_errors=True)
+                return False
+            os.replace(tmp, final)
+            log_debug("persist: stored entry %s (%d exec bytes)",
+                      digest[:12], len(exec_bytes))
+            return True
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+            try:
+                os.unlink(lease)
+            except OSError:
+                pass
+
+    # -- eviction / hygiene --------------------------------------------
+
+    def purge(self, digest: str) -> None:
+        """Drop one entry (best-effort; used when a restored
+        executable turned out not to fit this process's args)."""
+        shutil.rmtree(self._entry_dir(digest), ignore_errors=True)
+        self._preloaded.pop(digest, None)
+
+    def evict_epochs_before(self, epoch: int) -> int:
+        """Purge entries persisted under a dead mesh epoch (and any
+        entry whose manifest no longer parses — it could never load
+        anyway). Called through ``expr.base.evict_stale_plans`` after
+        an elastic ``rebuild_mesh``: without this, a restart would
+        resurrect plans for a mesh that no longer exists."""
+        n = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.startswith("entry_"):
+                continue
+            path = os.path.join(self.root, name)
+            if not os.path.isdir(path) or ".tmp-" in name:
+                continue
+            try:
+                with open(os.path.join(path, _MANIFEST)) as f:
+                    entry_epoch = int(json.load(f).get("mesh_epoch", 0))
+                if entry_epoch >= epoch:
+                    continue
+            except (OSError, ValueError, TypeError):
+                pass  # unreadable manifest: reap it below
+            shutil.rmtree(path, ignore_errors=True)
+            self._preloaded.pop(name[len("entry_"):], None)
+            n += 1
+        if n:
+            log_warn("persist: evicted %d dead-epoch entr%s from %s",
+                     n, "y" if n == 1 else "ies", self.root)
+        return n
+
+    # -- prewarm --------------------------------------------------------
+
+    def preload(self, digest: str, fingerprint: Dict[str, Any]) -> bool:
+        """Deserialize one entry into the in-memory prewarm table.
+        Returns False on a clean miss; raises like :meth:`load`."""
+        entry = self.load(digest, fingerprint, prewarm_ok=False)
+        if entry is None:
+            return False
+        self._preloaded[digest] = entry
+        return True
+
+    def preloaded_count(self) -> int:
+        return len(self._preloaded)
+
+    def write_manifest(self, path: str,
+                       digests: Optional[List[str]] = None) -> int:
+        """Write a prewarm manifest (the rolling-restart contract:
+        docs/WARMSTART.md) listing ``digests`` (default: every entry
+        currently in the store). Atomic via temp + replace."""
+        entries = list(digests) if digests is not None else self.digests()
+        tmp = path + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"version": FORMAT_VERSION, "entries": entries}, f,
+                      indent=1)
+        os.replace(tmp, path)
+        return len(entries)

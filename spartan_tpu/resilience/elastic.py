@@ -48,6 +48,7 @@ import re
 import threading
 from typing import Any, List, Optional, Sequence
 
+from .. import persist as persist_mod
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY
 from ..parallel import mesh as mesh_mod
@@ -143,8 +144,14 @@ def on_fatal_mesh(exc: BaseException, mesh: Any = None) -> Optional[Any]:
             from ..expr import base as expr_base
 
             with prof.phase("evict"):
+                # in-memory plans AND the warm-start store's on-disk
+                # entries of the dead epoch (spartan_tpu/persist) —
+                # without the disk half, a later restart would
+                # resurrect plans for the mesh that just died
                 evicted = expr_base.evict_stale_plans()
+                persisted = persist_mod.last_evicted()
             sp.set(drained=drained, evicted=evicted,
+                   persist_evicted=persisted,
                    survivors=int(new_mesh.devices.size))
         _count("elastic_recoveries",
                "fatal mesh failures recovered by drain/rebuild/evict")
@@ -154,10 +161,11 @@ def on_fatal_mesh(exc: BaseException, mesh: Any = None) -> Optional[Any]:
         _resume_serve()
         log_warn(
             "elastic: mesh epoch %d -> %d after device loss %s — %d "
-            "survivor(s), %d plan(s) evicted, %d serve request(s) "
-            "drained; resume loops from checkpoint, resubmit serve "
-            "requests", seen_epoch, mesh_mod._EPOCH, lost,
-            int(new_mesh.devices.size), evicted, drained)
+            "survivor(s), %d plan(s) evicted (+%d persisted entr%s), "
+            "%d serve request(s) drained; resume loops from "
+            "checkpoint, resubmit serve requests", seen_epoch,
+            mesh_mod._EPOCH, lost, int(new_mesh.devices.size), evicted,
+            persisted, "y" if persisted == 1 else "ies", drained)
         return new_mesh
 
 

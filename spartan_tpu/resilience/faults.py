@@ -16,8 +16,11 @@ Injection sites (the real seams):
   the dispatch to trip the PR-4 watchdog, ``FLAGS.dispatch_timeout_s``).
 * ``compile`` — the first (trace + XLA compile) run only. Fault:
   ``compile`` (an INVALID_ARGUMENT-style deterministic error).
-* ``checkpoint`` — ``utils/checkpoint`` save/load. Fault: ``io``
-  (an ``OSError``).
+* ``checkpoint`` — ``utils/checkpoint`` save/load AND the warm-start
+  store's entry load/store (``spartan_tpu/persist``; a clean store
+  miss consumes no occurrence). Fault: ``io`` (an ``OSError``) —
+  checkpoint faults surface to the caller's recovery policy, persist
+  faults degrade to a normal recompile / skipped persist.
 
 Spec grammar (``FLAGS.fault_inject`` or ``st.chaos(spec)``): a
 comma-separated list of tokens::

@@ -42,6 +42,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
+from .. import persist as persist_mod
 from ..expr import base
 from ..obs import flight as flight_mod
 from ..obs import ledger as ledger_mod
@@ -260,6 +261,32 @@ class ServeEngine:
 
     def __exit__(self, *exc: Any) -> None:
         self.stop()
+
+    # -- warm start (spartan_tpu/persist, docs/WARMSTART.md) ------------
+
+    def prewarm(self, manifest: Any = "all",
+                timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Restore a configured plan set from the warm-start store at
+        startup, OFF the request path: entries land in the store's
+        in-memory prewarm table, so the first request for each plan
+        pays neither XLA compile nor disk IO/deserialize.
+
+        ``manifest``: a path to a JSON ``{"entries": [digest, ...]}``
+        file (see ``persist.write_manifest`` — the rolling-restart
+        runbook's capture step), the dict/list itself, or ``"all"``
+        (every entry in the store). Per-entry timeout
+        (``timeout_s`` / ``FLAGS.persist_prewarm_timeout_s``) + error
+        isolation: a missing, corrupt or slow entry is counted
+        (``persist_prewarm_*`` metrics) and skipped — prewarm can
+        never crash or stall engine startup indefinitely. No-op with
+        the store off. Returns ``{loaded, missing, errors, total}``."""
+        stats = persist_mod.prewarm(manifest, timeout_s)
+        if _METRICS_FLAG._value:
+            REGISTRY.gauge(
+                "persist_prewarmed_plans",
+                "plans resident in the warm-start prewarm table"
+            ).set(float(persist_mod.stats().get("preloaded", 0)))
+        return stats
 
     # -- elastic recovery (resilience/elastic.py) -----------------------
 
@@ -546,6 +573,17 @@ class ServeEngine:
             self._solo_inner(r)
         finally:
             self.ledger.release(r.mem_bytes)
+        # warm-start provenance: if this dispatch built its plan, name
+        # whether the executable came from the persist store (disk) or
+        # a fresh XLA compile — the flight-record half of the
+        # st.explain persist line. None on plan-cache hits and with
+        # the store off; popped unconditionally so a stale outcome
+        # can never stamp a later request.
+        src = persist_mod.take_build_source()
+        if src is not None and flight_mod._FLIGHT_FLAG._value:
+            flight_mod.note(r.rid, "persist",
+                            **{k: v for k, v in src.items()
+                               if v is not None})
         self._flight_resolve(
             r, span, 1, "ok" if r.future._exc is None else "error")
 

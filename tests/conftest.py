@@ -34,6 +34,30 @@ except (AttributeError, ValueError):  # older/newer jax without the knob
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _isolate_persist_cache(request, tmp_path_factory):
+    """Warm-start store isolation: FLAGS.persist_cache_dir (and the
+    process-level store singleton behind it) must never leak state
+    between tests — a shared directory would let one test's persisted
+    executables satisfy another test's cache misses. If the flag is
+    set (an env override, or a prior test's leftovers), rebind it to a
+    fresh per-test tmpdir; always drop the store singleton + digest
+    memo afterwards. Tests that point the flag at their own tmp_path
+    are unaffected (their explicit set wins inside the test body)."""
+    from spartan_tpu import persist
+    from spartan_tpu.utils.config import FLAGS
+
+    prev = FLAGS.persist_cache_dir
+    if prev:
+        FLAGS.persist_cache_dir = str(
+            tmp_path_factory.mktemp("persist_cache"))
+        persist.reset()
+    yield
+    if FLAGS.persist_cache_dir != prev:
+        FLAGS.persist_cache_dir = prev
+    persist.reset()
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -444,3 +444,33 @@ def test_pallas_allowed_in_kernel_layer():
     other = ast.parse("if sel.pallas:\n    pass\n"
                       "name = 'pallas_call'\n")
     assert lint_repo.lint_pallas_imports("/x/y.py", other) == []
+
+
+def test_catches_persist_seam_violations(tmp_path):
+    bad = tmp_path / "bad_persist.py"
+    bad.write_text(
+        "from jax.experimental import serialize_executable as se\n"
+        "from jax.experimental.serialize_executable import "
+        "deserialize_and_load\n"
+        "import jax.experimental.serialize_executable as se2\n"
+        "payload = se.serialize(compiled)\n"
+        "d = FLAGS.persist_cache_dir\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_persist_seam(str(bad), tree)
+    assert sum(f.rule == "persist-seam" for f in findings) >= 4
+    assert all("spartan_tpu/persist" in f.message for f in findings)
+
+
+def test_persist_seam_allowed_in_persist_layer():
+    tree = ast.parse(
+        "from jax.experimental import serialize_executable as se\n"
+        "payload, it, ot = se.serialize(compiled)\n"
+        "c = se.deserialize_and_load(payload, it, ot)\n"
+        "d = FLAGS.persist_cache_dir\n")
+    for rel in (os.path.join("spartan_tpu", "persist", "store.py"),
+                os.path.join("spartan_tpu", "persist", "__init__.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_persist_seam(path, tree) == []
+    # ordinary attributes named like the API elsewhere are fine
+    other = ast.parse("x = obj.serialize\nname = 'persist_cache_dir'\n")
+    assert lint_repo.lint_persist_seam("/x/y.py", other) == []

@@ -1,0 +1,513 @@
+"""Warm-start persistence (spartan_tpu/persist, docs/WARMSTART.md).
+
+The contract under test: a populated store lets a fresh process (or a
+cache-cleared one) serve its plan set with ZERO XLA recompiles and
+bit-equal results — and EVERY hostile-store scenario (truncated /
+corrupt entry, version or fingerprint skew, ``io`` chaos on load and
+store, a concurrent writer's lease, a missing prewarm entry, a dead
+mesh epoch) degrades to a normal recompile with the reason surfaced
+in the ``persist_*`` metrics and ``st.explain`` — never a crash,
+never a wrong result.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu import persist
+from spartan_tpu.expr import base as expr_base
+from spartan_tpu.obs.metrics import REGISTRY, labeled
+from spartan_tpu.utils import profiling
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counters():
+    return REGISTRY.counter_values()
+
+
+class _Delta:
+    """Counter deltas vs construction time (the registry is global and
+    accumulates across tests)."""
+
+    def __init__(self):
+        self.base = REGISTRY.counter_values()
+
+    def __call__(self, name, **labels):
+        key = labeled(name, **labels) if labels else name
+        return (REGISTRY.counter_values().get(key, 0)
+                - self.base.get(key, 0))
+
+
+def _fresh(tmp_path, name="store"):
+    """Point the store at a fresh dir (the conftest fixture restores
+    the flag and resets the singleton after the test)."""
+    d = str(tmp_path / name)
+    st.FLAGS.persist_cache_dir = d
+    # also drop the in-memory plan/compile caches: identical-structure
+    # exprs from OTHER tests would hit the plan cache and the persist
+    # path (a miss-path feature) would never run
+    _restart()
+    return d
+
+
+def _restart():
+    """Simulate a process restart for the evaluation stack: drop the
+    in-memory plan/compile caches and the persist singleton's memos
+    (the on-disk store survives, like a real restart)."""
+    expr_base.clear_compile_cache()
+    persist.reset()
+    profiling.reset_counters()
+
+
+def _plan_set(seed=0, n=48):
+    rng = np.random.RandomState(seed)
+    x = st.from_numpy(rng.rand(n, n).astype(np.float32))
+    y = st.from_numpy(rng.rand(n, n).astype(np.float32))
+    return [
+        ((x + y) * 3.0 - x).sum(),
+        st.dot(x, y).sum(axis=0),
+    ]
+
+
+def _entry_dirs(d):
+    return sorted(p for p in os.listdir(d) if p.startswith("entry_")
+                  and not p.endswith(".lease") and ".tmp-" not in p)
+
+
+def _manifest_path(d, entry):
+    return os.path.join(d, entry, "manifest.json")
+
+
+def _rewrite_manifest(d, entry, mutate):
+    mp = _manifest_path(d, entry)
+    with open(mp) as f:
+        manifest = json.load(f)
+    mutate(manifest)
+    with open(mp, "w") as f:
+        json.dump(manifest, f)
+
+
+# -- the happy path ------------------------------------------------------
+
+
+def test_store_off_by_default(mesh2d):
+    assert st.FLAGS.persist_cache_dir == ""
+    assert persist.active() is None
+    out = _plan_set()[0].evaluate().glom()
+    assert np.isfinite(out).all()
+    assert persist.stats() == {"enabled": False}
+
+
+def test_round_trip_zero_recompiles_bit_equal(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    delta = _Delta()
+    cold = [e.evaluate().glom() for e in _plan_set()]
+    assert len(_entry_dirs(d)) == 2
+    assert delta("persist_stores") == 2
+
+    _restart()
+    warm = [e.evaluate().glom() for e in _plan_set()]
+    assert profiling.counters().get("compiles", 0) == 0, \
+        "a populated store must serve the plan set with ZERO recompiles"
+    assert delta("persist_hits") == 2
+    for c, w in zip(cold, warm):
+        np.testing.assert_array_equal(c, w)  # bit-equal, not allclose
+
+
+def test_explain_names_disk_hit_vs_compile(mesh2d, tmp_path):
+    _fresh(tmp_path)
+    e = _plan_set()[0]
+    e.evaluate()
+    rep = st.explain(_plan_set()[0], cost=False).to_dict()
+    assert rep["persist"]["source"] == "compile"
+    assert rep["persist"]["stored"] is True
+
+    _restart()
+    rep = st.explain(_plan_set()[0], cost=False)
+    assert rep.to_dict()["persist"]["source"] == "disk"
+    assert "persist: disk hit" in str(rep)
+    # and the explain pre-plan seeded the cache: evaluating now
+    # dispatches the restored executable
+    out = _plan_set()[0].evaluate().glom()
+    assert np.isfinite(out).all()
+    assert profiling.counters().get("compiles", 0) == 0
+
+
+def test_steady_state_hits_never_touch_the_store(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    e = _plan_set()[0]
+    e.evaluate()
+    delta = _Delta()
+    for _ in range(3):
+        out = _plan_set()[0].evaluate().glom()
+    assert np.isfinite(out).all()
+    assert delta("persist_hits") == 0
+    assert delta("persist_misses") == 0
+    assert len(_entry_dirs(d)) == 1
+
+
+def test_donation_variant_composes_with_restored_plan(mesh2d, tmp_path):
+    _fresh(tmp_path)
+    rng = np.random.RandomState(3)
+    a_np = rng.rand(32, 32).astype(np.float32)
+    a = st.from_numpy(a_np)
+    (st.as_expr(a) * 2.0).evaluate()
+
+    _restart()
+    a2 = st.from_numpy(a_np)
+    expr = st.as_expr(a2) * 2.0
+    out = expr.evaluate(donate=[a2]).glom()  # donation variant compiles
+    np.testing.assert_array_equal(out, a_np * 2.0)
+    with pytest.raises(Exception):
+        a2.glom()  # donated buffer invalidated as usual
+
+
+# -- hostile stores ------------------------------------------------------
+
+
+def test_corrupt_exec_rejected_crc_named(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    e = _plan_set()[0]
+    expected = e.evaluate().glom()
+    entry = _entry_dirs(d)[0]
+    blob = os.path.join(d, entry, "exec.bin")
+    with open(blob, "r+b") as f:  # flip bytes mid-file: CRC must trip
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef")
+
+    _restart()
+    delta = _Delta()
+    out = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out, expected)  # recompile fallback
+    assert delta("persist_load_errors", reason="crc") == 1
+    assert delta("persist_hits") == 0
+    rep = st.explain(_plan_set()[0], cost=False).to_dict()
+    assert rep["persist"]["source"] == "compile"
+
+
+def test_truncated_entry_rejected(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    expected = _plan_set()[0].evaluate().glom()
+    entry = _entry_dirs(d)[0]
+    blob = os.path.join(d, entry, "trees.pkl")
+    data = open(blob, "rb").read()
+    with open(blob, "wb") as f:
+        f.write(data[: max(1, len(data) // 2)])
+
+    _restart()
+    delta = _Delta()
+    out = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out, expected)
+    assert delta("persist_load_errors", reason="crc") == 1
+
+
+def test_version_skew_rejected(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    expected = _plan_set()[0].evaluate().glom()
+    entry = _entry_dirs(d)[0]
+    _rewrite_manifest(d, entry, lambda m: m.update(version=999))
+
+    _restart()
+    delta = _Delta()
+    out = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out, expected)
+    assert delta("persist_load_errors", reason="version") == 1
+
+
+def test_fingerprint_skew_rejected(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    expected = _plan_set()[0].evaluate().glom()
+    entry = _entry_dirs(d)[0]
+    _rewrite_manifest(
+        d, entry,
+        lambda m: m["fingerprint"].update(jax="0.0.0-foreign"))
+
+    _restart()
+    delta = _Delta()
+    out = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out, expected)
+    assert delta("persist_load_errors", reason="fingerprint") == 1
+    rep = st.explain(_plan_set()[0], cost=False).to_dict()
+    assert rep["persist"]["reason"] == "fingerprint"
+
+
+def test_plan_meta_mismatch_rejected_and_purged(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    expected = _plan_set()[0].evaluate().glom()
+    entry = _entry_dirs(d)[0]
+    pj = os.path.join(d, entry, "plan.json")
+    with open(pj) as f:
+        meta = json.load(f)
+    meta["arg_order"] = list(reversed(meta["arg_order"] or [0, 1]))
+    raw = json.dumps(meta, sort_keys=True).encode()
+    with open(pj, "wb") as f:
+        f.write(raw)
+    # keep the CRC honest so ONLY the belt check can reject it
+    _rewrite_manifest(
+        d, entry,
+        lambda m: m["files"].update(
+            {"plan.json": {"crc32": zlib.crc32(raw),
+                           "bytes": len(raw)}}))
+
+    _restart()
+    delta = _Delta()
+    out = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out, expected)
+    assert delta("persist_load_errors", reason="meta_mismatch") == 1
+    # the hostile entry was purged, then the recompile re-persisted a
+    # healthy one (self-healing): the next restart hits cleanly
+    assert delta("persist_stores") == 1
+    _restart()
+    delta = _Delta()
+    np.testing.assert_array_equal(_plan_set()[0].evaluate().glom(),
+                                  expected)
+    assert delta("persist_hits") == 1
+
+
+def test_io_chaos_on_load_degrades_to_recompile(mesh2d, tmp_path):
+    _fresh(tmp_path)
+    expected = _plan_set()[0].evaluate().glom()
+
+    _restart()
+    delta = _Delta()
+    with st.chaos("io@0"):
+        out = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out, expected)
+    assert delta("persist_load_errors", reason="io") == 1
+    assert profiling.counters().get("compiles", 0) == 1
+
+
+def test_io_chaos_on_store_never_fails_evaluate(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    delta = _Delta()
+    with st.chaos("io@0"):
+        out = _plan_set()[0].evaluate().glom()
+    assert np.isfinite(out).all()
+    assert _entry_dirs(d) == []  # nothing persisted...
+    assert delta("persist_store_errors", reason="io") == 1
+    # ...and a later recompile re-persists once the fault clears
+    _restart()
+    out2 = _plan_set()[0].evaluate().glom()
+    np.testing.assert_array_equal(out2, out)
+    assert len(_entry_dirs(d)) == 1
+
+
+def test_live_lease_blocks_writer_stale_lease_broken(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    store = persist.active()
+    # a live lease from "another replica": this process must skip
+    digest = "f" * 40
+    lease = os.path.join(d, f"entry_{digest}.lease")
+    with open(lease, "w") as f:
+        f.write("99999")
+    assert store.save(digest, {"mesh_epoch": 0}, {"x": 1}, b"bytes",
+                      (None, None)) is False
+    assert not store.has(digest)
+    # a STALE lease (writer died mid-persist) is broken and the write
+    # proceeds
+    old = 10.0
+    os.utime(lease, (old, old))
+    assert store.save(digest, {"mesh_epoch": 0}, {"x": 1}, b"bytes",
+                      (None, None)) is True
+    assert store.has(digest)
+    assert not os.path.exists(lease)
+
+
+def test_unstable_plan_key_skips_persistence(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    rng = np.random.RandomState(5)
+    arr = st.from_numpy(rng.rand(16, 16).astype(np.float32))
+    marker = object()  # lands in the closure cells via fn_key
+
+    def fn(v):
+        return v * (1.0 if marker else 0.0)
+
+    delta = _Delta()
+    out = st.map(fn, arr).evaluate().glom()
+    np.testing.assert_array_equal(out, np.asarray(arr.glom()))
+    assert delta("persist_unstable_keys") >= 1
+    assert _entry_dirs(d) == []  # not persistable, not persisted
+
+
+def test_dead_epoch_entries_purged_by_evict_stale_plans(
+        mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    _plan_set()[0].evaluate()
+    entry = _entry_dirs(d)[0]
+    # make the entry claim a long-dead mesh epoch (as a pre-rebuild
+    # writer would have): evict_stale_plans must reap it on disk
+    _rewrite_manifest(d, entry, lambda m: m.update(mesh_epoch=-1))
+    expr_base.evict_stale_plans()
+    assert entry not in _entry_dirs(d)
+    assert persist.last_evicted() == 1
+    # idempotent + still no crash on an empty store
+    expr_base.evict_stale_plans()
+    assert persist.last_evicted() == 0
+
+
+# -- prewarm -------------------------------------------------------------
+
+
+def test_prewarm_restores_plan_set_off_request_path(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    cold = [e.evaluate().glom() for e in _plan_set()]
+    digests = persist.active().digests()
+    manifest_path = str(tmp_path / "prewarm.json")
+    assert persist.write_manifest(manifest_path) == 2
+
+    _restart()
+    eng = st.serve.ServeEngine(workers=1)
+    try:
+        stats = eng.prewarm(manifest_path)
+        assert stats["loaded"] == 2 and stats["errors"] == 0
+        assert persist.stats()["preloaded"] == 2
+        futs = [eng.submit(e) for e in _plan_set()]
+        warm = [f.glom() for f in futs]
+    finally:
+        eng.stop()
+    assert profiling.counters().get("compiles", 0) == 0
+    for c, w in zip(cold, warm):
+        np.testing.assert_array_equal(c, w)
+    # the flight recorder names the disk hit for the built requests
+    kinds = [ev.kind for ev in st.obs.flight.events()]
+    assert "persist" in kinds
+    assert sorted(digests) == sorted(persist.active().digests())
+
+
+def test_prewarm_missing_and_corrupt_entries_isolated(
+        mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    _plan_set()[0].evaluate()
+    good = _entry_dirs(d)[0][len("entry_"):]
+    bad_dir = _entry_dirs(d)[0]
+    # a second, corrupt entry + a missing digest in the manifest
+    corrupt = "a" * 40
+    import shutil
+
+    shutil.copytree(os.path.join(d, bad_dir),
+                    os.path.join(d, f"entry_{corrupt}"))
+    with open(os.path.join(d, f"entry_{corrupt}", "exec.bin"),
+              "r+b") as f:
+        f.seek(4)
+        f.write(b"\x00\x00\x00\x00")
+    _restart()
+    delta = _Delta()
+    stats = persist.prewarm([good, corrupt, "b" * 40])
+    assert stats["loaded"] == 1
+    assert stats["errors"] == 1  # corrupt: counted, isolated
+    assert stats["missing"] == 1  # absent: counted, isolated
+    assert delta("persist_prewarm_errors", reason="crc") == 1
+
+
+def test_prewarm_per_entry_timeout(mesh2d, tmp_path, monkeypatch):
+    _fresh(tmp_path)
+    _plan_set()[0].evaluate()
+    _restart()
+    store = persist.active()
+    import time as _time
+
+    def slow_preload(digest, fp):
+        _time.sleep(0.5)
+        return True
+
+    delta = _Delta()
+    monkeypatch.setattr(store, "preload", slow_preload)
+    stats = persist.prewarm("all", timeout_s=0.05)
+    assert stats["errors"] == stats["total"] >= 1
+    assert delta("persist_prewarm_errors", reason="timeout") >= 1
+
+
+def test_prewarm_noop_with_store_off(mesh2d):
+    assert persist.active() is None
+    eng = st.serve.ServeEngine(workers=1)
+    try:
+        stats = eng.prewarm("all")
+    finally:
+        eng.stop()
+    assert stats["loaded"] == 0 and stats["errors"] == 0
+
+
+# -- cross-process (the real restart + the shared cache dir) -------------
+
+_CHILD = r"""
+import json, sys
+import numpy as np
+sys.path.insert(0, "@REPO@")
+import spartan_tpu as st
+from spartan_tpu.utils import profiling
+st.FLAGS.persist_cache_dir = sys.argv[1]
+rng = np.random.RandomState(0)
+x = st.from_numpy(rng.rand(48, 48).astype(np.float32))
+y = st.from_numpy(rng.rand(48, 48).astype(np.float32))
+outs = [((x + y) * 3.0 - x).sum().glom(),
+        st.dot(x, y).sum(axis=0).glom()]
+m = st.metrics()["counters"]
+print(json.dumps({
+    "compiles": profiling.counters().get("compiles", 0),
+    "hits": m.get("persist_hits", 0),
+    "stores": m.get("persist_stores", 0),
+    "digest": [float(np.asarray(o).sum()) for o in outs],
+    "bytes": [np.asarray(o).tobytes().hex()[:64] for o in outs],
+}))
+"""
+
+
+def _run_child(cache_dir, timeout=180):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    return subprocess.Popen(
+        [sys.executable, "-c", _CHILD.replace("@REPO@", REPO),
+         cache_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+
+
+def test_warm_restart_across_processes_acceptance(tmp_path):
+    """The acceptance criterion: a FRESH process with a populated
+    store serves the plan set with zero recompiles and bit-equal
+    results vs the cold run."""
+    d = str(tmp_path / "shared")
+    p = _run_child(d)
+    out, err = p.communicate(timeout=240)
+    assert p.returncode == 0, err
+    cold = json.loads(out.strip().splitlines()[-1])
+    assert cold["compiles"] == 2 and cold["stores"] == 2, (cold, err)
+
+    p = _run_child(d)
+    out, err = p.communicate(timeout=240)
+    assert p.returncode == 0, err
+    warm = json.loads(out.strip().splitlines()[-1])
+    assert warm["compiles"] == 0, (warm, err)
+    assert warm["hits"] == 2
+    assert warm["bytes"] == cold["bytes"]  # bit-equal across processes
+
+
+def test_two_processes_share_one_cache_dir_concurrently(tmp_path):
+    """Two replicas racing the same (empty) store: lock-free readers +
+    lease writers — no crash, both bit-equal, and the store ends up
+    consistent (each entry written exactly once per lease round)."""
+    d = str(tmp_path / "shared")
+    procs = [_run_child(d), _run_child(d)]
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    assert results[0]["bytes"] == results[1]["bytes"]
+    # the store is complete and immediately usable by a third process
+    p = _run_child(d)
+    out, err = p.communicate(timeout=240)
+    assert p.returncode == 0, err
+    warm = json.loads(out.strip().splitlines()[-1])
+    assert warm["compiles"] == 0 and warm["hits"] == 2, (warm, err)
+    assert warm["bytes"] == results[0]["bytes"]

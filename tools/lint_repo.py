@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Eleven repo-specific rules that generic linters cannot know:
+Thirteen repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -117,6 +117,23 @@ Eleven repo-specific rules that generic linters cannot know:
     labels — a raw scope elsewhere invents names the attribution
     report can never map back to an expr node.
 
+12. No ``jax.experimental.pallas`` import (or ``pallas_call`` use)
+    outside ``spartan_tpu/kernels/`` (the partitionable-kernel PR):
+    every Pallas kernel goes through the kernel layer so its grid
+    derives from the committed tiling and its backend choice is
+    keyed, selectable and explainable (docs/KERNELS.md).
+
+13. No JAX AOT executable-serialization use
+    (``jax.experimental.serialize_executable`` — ``serialize`` /
+    ``deserialize_and_load``) and no direct ``FLAGS.persist_cache_dir``
+    reads outside ``spartan_tpu/persist/`` (the warm-start PR): the
+    store owns the fingerprint rule, the CRC/atomic-write discipline,
+    the lease-writer protocol and the degrade-to-recompile contract
+    (docs/WARMSTART.md) — a stray serialize call produces bytes no
+    fingerprint protects, and a stray dir read bypasses the store
+    singleton's failure handling. Go through ``spartan_tpu.persist``
+    (``active()`` / ``lookup()`` / ``maybe_store()`` / ``prewarm()``).
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -221,6 +238,16 @@ _WSC_ALLOWED_FILES = {
 # mesh outlives rebuild_mesh and dodges the epoch fence
 _MESH_MAKERS = {"get_mesh", "build_mesh", "rebuild_mesh", "Mesh"}
 _MESH_ALLOWED_DIRS = (os.path.join("spartan_tpu", "parallel") + os.sep,)
+
+# rule 13: the warm-start store (spartan_tpu/persist) is the only
+# owner of JAX AOT executable serialization and of the persist
+# directory itself — everyone else goes through the persist API so
+# fingerprints, CRCs, leases and degrade-to-recompile stay in one
+# place
+_PERSIST_ALLOWED_DIRS = (os.path.join("spartan_tpu", "persist")
+                         + os.sep,)
+_PERSIST_SERIALIZE_NAMES = {"serialize_executable",
+                            "deserialize_and_load"}
 
 # rule 12: Pallas is the kernel layer's private dependency. A raw
 # pallas_call outside spartan_tpu/kernels/ bypasses the selection
@@ -722,6 +749,49 @@ def lint_pallas_imports(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_persist_seam(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 13: JAX AOT executable serialization
+    (``jax.experimental.serialize_executable``) and direct
+    ``persist_cache_dir`` flag access only inside
+    ``spartan_tpu/persist/`` — the store owns the fingerprint /
+    CRC / lease / degrade contract (docs/WARMSTART.md)."""
+    rel = os.path.relpath(path, REPO)
+    if any(rel.startswith(d) for d in _PERSIST_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "persist-seam",
+            f"{what}: the warm-start store (spartan_tpu/persist, "
+            "docs/WARMSTART.md) owns AOT serialization and the "
+            "persist directory — go through spartan_tpu.persist "
+            "(active()/lookup()/maybe_store()/prewarm())"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "serialize_executable" in mod.split("."):
+                flag(node, f"import from {mod!r}")
+            elif any(a.name in _PERSIST_SERIALIZE_NAMES
+                     for a in node.names):
+                flag(node, "binds the AOT serialization API")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if "serialize_executable" in a.name.split("."):
+                    flag(node, f"import {a.name}")
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in _PERSIST_SERIALIZE_NAMES:
+            flag(node, f"attribute use of {node.attr}")
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "persist_cache_dir":
+            # FLAGS.persist_cache_dir reads/writes outside the store:
+            # the path must be resolved through persist.active() so a
+            # broken directory degrades instead of erroring ad hoc
+            flag(node, "direct persist_cache_dir access")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -813,6 +883,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_named_scopes(path, tree))
         findings.extend(lint_sharding_constraints(path, tree))
         findings.extend(lint_pallas_imports(path, tree))
+        findings.extend(lint_persist_seam(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
