@@ -43,12 +43,22 @@ from typing import Any, Dict, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# metric-name suffixes where smaller is the improvement
-_LOWER_BETTER = ("seconds", "_ratio", "sec_per_iter", "_s")
+# metric-name suffixes where smaller is the improvement. "_us" covers
+# the elastic-recovery breakdown columns (drain/rebuild/evict/migrate)
+_LOWER_BETTER = ("seconds", "_ratio", "sec_per_iter", "_s", "_us")
+
+# informational columns with no orientation: byte/count volumes (a
+# bigger migration moved more state, neither better nor worse) — their
+# deltas are reported flat, never as a regression
+_NEUTRAL = ("_bytes", "_arrays", "devices_before", "devices_after")
 
 
 def _lower_better(name: str) -> bool:
     return any(name.endswith(sfx) for sfx in _LOWER_BETTER)
+
+
+def _neutral(name: str) -> bool:
+    return any(name.endswith(sfx) for sfx in _NEUTRAL)
 
 
 def _num(v: Any) -> Optional[float]:
@@ -180,7 +190,9 @@ def compare(old_doc: Dict[str, Any], new_doc: Dict[str, Any],
     for name in sorted(set(old_m) & set(new_m)):
         o, n = old_m[name], new_m[name]
         entry: Dict[str, Any] = {"old": o, "new": n}
-        if o > 0:
+        if _neutral(name):
+            entry["verdict"] = "info"  # volume column: no orientation
+        elif o > 0:
             ratio = n / o
             entry["ratio"] = round(ratio, 4)
             lower = _lower_better(name)

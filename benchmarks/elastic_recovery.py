@@ -135,15 +135,23 @@ def measure_overhead(iters: int = 100, n: int = 4096, d: int = 32,
 def measure_resume(n: int = 1024, d: int = 32) -> dict:
     """Time-to-resume: warm plan on the full mesh, inject device loss,
     stopwatch from the failing dispatch to the first completed
-    evaluation on the rebuilt mesh."""
+    evaluation on the rebuilt mesh — broken down by
+    drain / rebuild / migrate (the planned rehome of a live sharded
+    array through the cross-mesh migration pipeline) with a
+    migrated-bytes column."""
     import spartan_tpu as st
+    from spartan_tpu.array import tiling
     from spartan_tpu.parallel import mesh as mesh_mod
+    from spartan_tpu.resilience import elastic
 
     rng = np.random.RandomState(1)
     a = rng.rand(n, d).astype(np.float32)
     x = st.from_numpy(a)
     (x * 2.0).sum().glom()  # warm: plan + executable on the full mesh
     devices_before = mesh_mod.get_mesh().devices.size
+    # a live row-sharded array that must survive the shrink: its
+    # planned migration is the "migrate" column below
+    live = st.from_numpy(a, tiling=tiling.row(2))
 
     st.chaos("device_loss@0")
     t0 = time.perf_counter()
@@ -155,6 +163,8 @@ def measure_resume(n: int = 1024, d: int = 32) -> dict:
         except st.FatalMeshError:
             pass  # recovery (drain/rebuild/evict) ran inside
         st.chaos_clear()
+        # planned migration of the live array onto the survivors
+        migrated = elastic.rehome([live])
         # replan + first dispatch on the shrunken mesh
         x3 = st.from_numpy(a)
         (x3 * 2.0).sum().glom()
@@ -162,12 +172,14 @@ def measure_resume(n: int = 1024, d: int = 32) -> dict:
         st.chaos_clear()
     t_resume = time.perf_counter() - t0
 
-    hists = st.metrics()["histograms"]
+    met = st.metrics()
+    hists = met["histograms"]
 
     def phase_us(name):
         h = hists.get(f"phase:{name}")
         return round(h["max"] * 1e6, 1) if h else None
 
+    routes = [r.get("route") for r in elastic.last_rehome_report()]
     out = {
         "time_to_resume_s": round(t_resume, 4),
         "devices_before": int(devices_before),
@@ -175,6 +187,11 @@ def measure_resume(n: int = 1024, d: int = 32) -> dict:
         "drain_us": phase_us("drain"),
         "rebuild_us": phase_us("rebuild"),
         "evict_us": phase_us("evict"),
+        "migrate_us": phase_us("migrate"),
+        "migrated_arrays": int(migrated),
+        "migrated_bytes": int(
+            met["counters"].get("elastic_migrated_bytes", 0)),
+        "migrate_routes": routes,
     }
     mesh_mod.reset_epoch_for_tests()
     return out

@@ -76,7 +76,7 @@ class DistArray:
     ambient mesh."""
 
     __slots__ = ("_jax", "tiling", "mesh", "_donate_next", "_donate_site",
-                 "_epoch")
+                 "_epoch", "_migration")
 
     def __init__(self, jax_array: jax.Array, tiling: Tiling,
                  mesh: Optional[Mesh] = None):
@@ -86,6 +86,7 @@ class DistArray:
         self._jax = jax_array
         self._donate_next = False
         self._donate_site = None
+        self._migration = None  # planned cross-mesh migration record
         self.tiling = tiling
         self.mesh = mesh or mesh_mod.get_mesh()
         # birth epoch: using this array after a rebuild_mesh (its
@@ -242,16 +243,62 @@ class DistArray:
         handle (loop closures, caches). Valid only while the buffers
         are still fetchable (replicated arrays, or simulated loss);
         an array whose shards died with the device must be re-created
-        from source — elastic recovery says so in its error."""
+        from source — elastic recovery says so in its error.
+
+        The migration is PLANNED (``parallel/redistribute.plan_rehome``,
+        docs/REDISTRIBUTION.md "cross-mesh-shape transitions"): the
+        chosen schedule, modeled wire bytes, route and reason land on
+        ``self._migration`` — ``resilience/elastic.rehome`` folds them
+        into the ``elastic_*`` metrics and the recovery span, and
+        ``st.explain`` names them per migrated leaf. The ``direct``
+        route repartitions sharding-to-sharding (``jax.device_put``,
+        ICI where the runtime can); anything else — indivisible on the
+        survivor grid, tuple-sharded flat_row axes, a failed direct
+        transfer — takes the gather (host round-trip) route.
+
+        A donated/invalidated handle is SKIPPED with a labeled reason,
+        never crashed on: its buffer is gone by contract, and recovery
+        must keep healing the arrays that still have one."""
+        if self._jax is None:
+            # invalidated by donation: nothing to migrate; record the
+            # reason so the recovery span can label the skip
+            self._migration = {
+                "route": "skipped", "bytes": 0,
+                "reason": "buffer invalidated by donation"}
+            return self
         if self._epoch == mesh_mod._EPOCH:
             return self
+        from ..parallel import redistribute as redist_mod
+
         mesh = mesh_mod.get_mesh()
-        host = np.asarray(jax.device_get(self.jax_array))
-        t = tiling_mod.sanitize(self.tiling, host.shape, mesh)
-        self._jax = jax.device_put(host, t.sharding(mesh))
+        t, dec = redist_mod.plan_rehome(self, mesh)
+        mig = {
+            "route": dec.route, "bytes": int(dec.bytes),
+            "schedule": (dec.schedule.describe()
+                         if dec.schedule is not None else None),
+            "reason": dec.reason, "shape": self.shape,
+            "src_tiling": self.tiling.axes, "dst_tiling": t.axes,
+            "from_epoch": self._epoch, "to_epoch": mesh_mod._EPOCH,
+        }
+        arr = None
+        if dec.route == "direct":
+            try:
+                arr = jax.device_put(self._jax, t.sharding(mesh))
+            except Exception as e:  # noqa: BLE001 - a real device loss
+                # can fail the direct repartition mid-transfer; the
+                # gather route below reads whatever is still fetchable
+                mig["route"] = "gather"
+                mig["reason"] = (f"{dec.reason}; direct transfer "
+                                 f"failed ({type(e).__name__}), host "
+                                 "gather fallback")
+        if arr is None:
+            host = np.asarray(jax.device_get(self._jax))
+            arr = jax.device_put(host, t.sharding(mesh))
+        self._jax = arr
         self.tiling = t
         self.mesh = mesh
         self._epoch = mesh_mod._EPOCH
+        self._migration = mig
         return self
 
     # -- data health (obs/numerics.py, the numerics sentinel) -----------

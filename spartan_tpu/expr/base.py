@@ -1092,6 +1092,10 @@ def _opt_flags_key() -> Tuple:
         # structural signature, so native and fallback plans are keyed
         # apart the same way (platform is process-constant; flag
         # writes bump the memo version)
+        # carry sharding (expr/loop FLAGS.shard_loop_carries) changes
+        # the loop program's layout constraints: sharded-carry and
+        # replicated-carry plans must never alias (the chosen layouts
+        # are also in LoopExpr._sig — this is the cheap belt)
         key = (tuple(p.name for p in _PASSES if p.enabled()),
                FLAGS.opt_fold_slices, FLAGS.placement,
                FLAGS.tiling_compute_weight, FLAGS.tiling_flop_weight,
@@ -1099,6 +1103,7 @@ def _opt_flags_key() -> Tuple:
                FLAGS.tiling_memory_weight,
                bool(FLAGS.audit_numerics), cal,
                bool(FLAGS.redistribution_planner),
+               bool(getattr(FLAGS, "shard_loop_carries", False)),
                kernels_mod.policy_key())
         _opt_key_memo = (ver, key)
     return key + (getattr(degrade_mod._TLS, "rung", None),)

@@ -33,6 +33,48 @@ from ..utils import profiling as prof
 from ..utils.config import FLAGS
 from .base import Expr, ValExpr, as_expr
 
+FLAGS.define_bool(
+    "shard_loop_carries", False,
+    "Shard large replicated loop carries across the mesh instead of "
+    "keeping one full copy per chip (the cross-replica weight-update "
+    "sharding construction): a carry whose init is replicated and at "
+    "least shard_carry_min_bytes large is constrained to the default "
+    "divisible tiling for the whole loop — inits are re-tiled on "
+    "entry, every iteration's outputs keep the sharded layout, and "
+    "the final carries come back sharded. Opt-in: reduction orders "
+    "inside non-elementwise bodies may change; keyed into BOTH the "
+    "plan and compile cache keys so sharded and replicated loop "
+    "programs never alias.")
+FLAGS.define_int(
+    "shard_carry_min_bytes", 1 << 16,
+    "Minimum carry size (bytes) for FLAGS.shard_loop_carries to "
+    "shard it: tiny carries (scalars, small stats) stay replicated — "
+    "resharding them costs more than their residency.")
+
+
+def _carry_shard_tiling(ini: "Expr", shape: Tuple[int, ...],
+                        dtype: Any) -> Optional[Tiling]:
+    """The sharded layout a loop carry gets under
+    ``FLAGS.shard_loop_carries``, or None to keep the init's own
+    tiling: only replicated, large-enough carries with a divisible
+    axis are sharded (the default_tiling rule — largest divisible
+    axis onto the mesh row axis)."""
+    if not FLAGS.shard_loop_carries or not shape:
+        return None
+    try:
+        t0 = ini.out_tiling()
+    except Exception:  # noqa: BLE001 - advisory: keep the default
+        return None
+    if any(a is not None for a in t0.axes):
+        return None  # already sharded: the user/DP chose a layout
+    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if nbytes < FLAGS.shard_carry_min_bytes:
+        return None
+    t = tiling_mod.default_tiling(shape)
+    if all(a is None for a in t.axes):
+        return None  # nothing divides: replication is all there is
+    return t
+
 
 class CarryExpr(Expr):
     """Symbolic leaf bound to the loop-carried value inside the body DAG.
@@ -41,10 +83,14 @@ class CarryExpr(Expr):
     body environment with the ``fori_loop`` carry."""
 
     def __init__(self, shape: Tuple[int, ...], dtype: Any, slot: int,
-                 tiling: Tiling):
+                 tiling: Tiling, sharded: bool = False):
         super().__init__(shape, dtype)
         self.slot = slot
         self._tiling = tiling
+        # True when FLAGS.shard_loop_carries overrode a replicated
+        # init: the loop constrains this carry to _tiling on entry
+        # and on every iteration's output
+        self.sharded = sharded
 
     def children(self) -> Tuple[Expr, ...]:
         return ()
@@ -144,6 +190,18 @@ class LoopExpr(Expr):
         inits = tuple(
             jnp.asarray(i.lower(env), b.dtype)
             for i, b in zip(self.init, self.body_roots))
+        sharded = any(c.sharded for c in self.carries)
+        if sharded:
+            # cross-replica carry sharding (FLAGS.shard_loop_carries):
+            # re-tile the replicated inits once on entry; the matching
+            # constraint on every body output below keeps the carry
+            # sharded across iterations, so the loop's resident state
+            # is 1/p per chip instead of one full copy per chip
+            from ..parallel import redistribute as redist_mod
+
+            inits = tuple(
+                redist_mod.constrain(v, ce._tiling) if ce.sharded else v
+                for v, ce in zip(inits, self.carries))
         trace_steps = FLAGS.trace_loop_steps
         label = f"loop#{self._id}"
 
@@ -162,7 +220,15 @@ class LoopExpr(Expr):
                     functools.partial(obs_trace.record_loop_step,
                                       label), i)
             with obs_trace.named_scope("st_loop_body"):
-                return tuple(b.lower(benv) for b in self.body_roots)
+                out = tuple(b.lower(benv) for b in self.body_roots)
+            if sharded:
+                from ..parallel import redistribute as redist_mod
+
+                out = tuple(
+                    redist_mod.constrain(o, ce._tiling)
+                    if ce.sharded else o
+                    for o, ce in zip(out, self.carries))
+            return out
 
         def health_of(i: Any, old: Tuple[Any, ...],
                       new: Tuple[Any, ...]) -> Tuple[Any, Any]:
@@ -215,8 +281,16 @@ class LoopExpr(Expr):
         return lax.while_loop(w_cond, w_body, state0)[1]
 
     def _sig(self, ctx) -> Tuple:
+        # the carry-sharding layout is structural: a loop whose carry
+        # is constrained to a sharded tiling compiles a different
+        # program than the replicated one, so the chosen layouts are
+        # part of the signature (None when carry sharding is off) —
+        # plan AND compile keys separate automatically
+        shard = tuple(c._tiling.axes if c.sharded else None
+                      for c in self.carries) \
+            if any(c.sharded for c in self.carries) else None
         head = (("loop", bool(FLAGS.trace_loop_steps), self.health,
-                 self.early_exit, self.stall_tol,
+                 self.early_exit, self.stall_tol, shard,
                  ctx.of(self.n_expr))
                 + tuple(ctx.of(i) for i in self.init))
         # bind the carries for the body traversal (see CarryExpr._sig)
@@ -295,6 +369,12 @@ class LoopItemExpr(Expr):
         return ("loopitem", self.idx, ctx.of(self.loop))
 
     def _default_tiling(self) -> Tiling:
+        carry = self.loop.carries[self.idx]
+        if carry.sharded:
+            # the loop constrains this carry's outputs to the sharded
+            # layout every iteration — declare it so the plan's out
+            # tilings (and anything consuming the result) agree
+            return carry._tiling
         return self.loop.body_roots[self.idx].out_tiling()
 
 
@@ -363,10 +443,15 @@ def loop(n_iters: Any, body_fn: Callable, *init: Any,
     index_expr = LoopIndexExpr() if with_index else None
 
     def build(carry_specs: Tuple[Tuple[Tuple[int, ...], Any], ...]):
-        carries = tuple(
-            CarryExpr(shape, dtype, slot, ini.out_tiling())
-            for slot, ((shape, dtype), ini)
-            in enumerate(zip(carry_specs, init_exprs)))
+        carries = []
+        for slot, ((shape, dtype), ini) in enumerate(
+                zip(carry_specs, init_exprs)):
+            shard_t = _carry_shard_tiling(ini, shape, dtype)
+            carries.append(CarryExpr(
+                shape, dtype, slot,
+                shard_t if shard_t is not None else ini.out_tiling(),
+                sharded=shard_t is not None))
+        carries = tuple(carries)
         args = ((index_expr,) if with_index else ()) + carries
         out = body_fn(*args)
         if not isinstance(out, (tuple, list)):

@@ -240,6 +240,24 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
     except Exception:  # noqa: BLE001 - the report is advisory
         pass
 
+    # planned cross-mesh migrations (elastic re-tiling): leaves that
+    # were rehomed or restored through the redistribution planner
+    # carry a _migration record — schedule, route, modeled wire
+    # bytes, reason (docs/RESILIENCE.md "cross-mesh migration")
+    migrations = None
+    try:
+        migs = []
+        for leaf in leaves:
+            arr = getattr(leaf, "value", None)
+            if arr is None:
+                arr = getattr(leaf, "_result", None)
+            m = getattr(arr, "_migration", None)
+            if m:
+                migs.append(dict(m))
+        migrations = migs or None
+    except Exception:  # noqa: BLE001 - the report is advisory
+        pass
+
     report: Dict[str, Any] = {
         "root": _label(expr),
         "site": _site_str(expr._site),
@@ -260,6 +278,7 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
         "out_tilings": [t.axes for t in out_tilings],
         "tilings": _tiling_entries(dag),
         "reshard_edges": _reshard_edges(dag),
+        "migrations": migrations,
         "donation": {"last_donated_args": None, "donated_dispatches": 0},
         "arg_specs": _arg_specs(leaves),
         "cost_analysis": None,
@@ -395,6 +414,22 @@ class ExplainReport:
                     # which path the lowering took (the one-call A/B)
                     line += (f" via {e['schedule']} [{e['path']}, "
                              f"cost~{e['modeled_cost']}]")
+                lines.append(line)
+        if d.get("migrations"):
+            # leaves that crossed a mesh-shape transition (elastic
+            # rehome / checkpoint restore) through the migration
+            # planner: per-array schedule + bytes + route + reason
+            lines.append("  migrations (cross-mesh re-tiling):")
+            for m in d["migrations"]:
+                line = (f"    {str(m.get('shape', '?')):<14} "
+                        f"{str(m.get('src_tiling', '?'))} -> "
+                        f"{str(m.get('dst_tiling', '?'))} "
+                        f"[{m.get('route')}, "
+                        f"~{m.get('bytes', 0)} B]")
+                if m.get("schedule"):
+                    line += f" via {m['schedule']}"
+                if m.get("reason"):
+                    line += f" ({m['reason']})"
                 lines.append(line)
         dp = d.get("device_profile")
         if dp:

@@ -52,10 +52,24 @@ _epoch_lock = threading.Lock()
 _global_mesh: Optional[Mesh] = None
 _excluded_ids: Tuple[int, ...] = ()
 
+# epoch -> {axis: size} of the mesh that generation ran on. Recorded
+# by rebuild_mesh (both the dying and the rebuilt shape), so the
+# cross-mesh migration planner (parallel/redistribute.plan_transition)
+# and the recovery spans can name the source grid of an artifact whose
+# mesh object is gone — e.g. a loop carry restored from a snapshot
+# written two epochs ago.
+_shape_history: dict = {}
+
 
 def mesh_epoch() -> int:
     """The current mesh generation (bumped by ``rebuild_mesh``)."""
     return _EPOCH
+
+
+def mesh_shape_at(epoch: int) -> Optional[dict]:
+    """The {axis: size} grid of mesh generation ``epoch``, when known
+    (rebuild_mesh records both sides of every transition)."""
+    return _shape_history.get(int(epoch))
 
 
 class StaleMeshError(RuntimeError):
@@ -170,12 +184,17 @@ def rebuild_mesh(exclude_devices: Sequence = (),
     first and evicting the dead epoch's cache entries after."""
     global _EPOCH, _global_mesh, _excluded_ids
     with _epoch_lock:
+        if _global_mesh is not None:
+            _shape_history.setdefault(
+                _EPOCH, {k: int(v) for k, v in _global_mesh.shape.items()})
         excluded = set(_excluded_ids)
         for d in exclude_devices:
             excluded.add(d if isinstance(d, int) else d.id)
         _excluded_ids = tuple(sorted(excluded))
         _EPOCH += 1
         _global_mesh = _build_surviving(shape)
+        _shape_history[_EPOCH] = {k: int(v)
+                                  for k, v in _global_mesh.shape.items()}
         _state.mesh = _global_mesh
         _state.epoch = _EPOCH
         from ..utils.log import log_warn
@@ -195,6 +214,7 @@ def reset_epoch_for_tests() -> None:
         _EPOCH = 0
         _global_mesh = None
         _excluded_ids = ()
+        _shape_history.clear()
         _state.mesh = None
         _state.epoch = 0
 

@@ -107,6 +107,8 @@ class Step(NamedTuple):
         if self.kind == "all_to_all":
             return (f"all_to_all[{self.mesh_axis}:"
                     f"{self.axis}->{self.to_axis}]")
+        if self.kind == "transfer":  # the cross-grid hop has no axis
+            return "transfer"
         return f"{self.kind}[{self.mesh_axis}:{self.axis}]"
 
 
@@ -169,6 +171,11 @@ class Schedule:
 def _axis_size(sizes: Dict[str, int], ax: Any) -> int:
     if ax is None:
         return 1
+    if isinstance(ax, tuple):  # multi-axis split (flat_row)
+        p = 1
+        for sub in ax:
+            p *= sizes.get(sub, 1)
+        return p
     return sizes.get(ax, 1)
 
 
@@ -406,6 +413,232 @@ def _cal_factors() -> Optional[Dict[str, float]]:
     from ..obs import ledger
 
     return ledger.factors()
+
+
+# -- cross-MESH-SHAPE transitions (elastic re-tiling) ---------------------
+#
+# Everything above plans src -> dst transitions on ONE mesh. An elastic
+# recovery (parallel/mesh.rebuild_mesh after host/device loss) changes
+# the mesh SHAPE: an M-device grid becomes an N-device survivor grid,
+# and every live array and restored loop carry must be re-partitioned
+# across grids. The same decomposition idea applies ("Memory-efficient
+# array redistribution", PAPERS.md), with one extra step kind:
+#
+#   * ``transfer`` — the cross-grid hop itself: each destination chip
+#     receives its shard of the CURRENT tiling state under the
+#     destination grid's sizes. A fully-replicated state transfers for
+#     free onto a survivor subset (every survivor already holds a full
+#     copy); a sharded state re-fetches one destination-local shard per
+#     chip (shard boundaries shift when the grid size changes).
+#
+# A cross-mesh schedule is then [gathers on the source grid]* +
+# transfer + [local slices on the destination grid]*. The degenerate
+# all-gather-everything + transfer(free) + slice route is the model of
+# the gather fallback (host round-trip / GSPMD re-tile) — the route
+# :meth:`DistArray.rehome` always had; the planner's job is to emit
+# the cheaper direct repartition where every intermediate state
+# divides the shape on its grid, and a REASONED fallback otherwise
+# (tuple-sharded ``flat_row`` axes stay fallback: the step vocabulary
+# cannot express a two-axis peel, and the reason says so).
+
+
+class MigrationDecision(NamedTuple):
+    """The planner's verdict for one cross-mesh-shape migration:
+    ``schedule`` (None when nothing was plannable), ``route`` —
+    ``direct`` (divisible repartition: executed as a sharding-to-
+    sharding transfer), ``gather`` (replicate-then-carve fallback) or
+    ``noop`` — the modeled per-chip wire ``cost`` (factored), total
+    modeled ``bytes`` on the wire, and a human ``reason`` for the
+    recovery span / ``st.explain`` migrations section."""
+
+    schedule: Optional[Schedule]
+    route: str
+    cost: float
+    bytes: float
+    reason: str
+
+
+# (src axes, dst axes, src grid items, dst grid items) -> schedules.
+_cross_memo: Dict[Tuple, Tuple[Schedule, ...]] = {}
+
+
+def _enumerate_cross(src_axes: Tuple, dst_axes: Tuple,
+                     src_sizes: Dict[str, int],
+                     dst_sizes: Dict[str, int]) -> Tuple[Schedule, ...]:
+    """DFS over cross-grid schedules: phase 0 releases source-grid
+    shardings (``all_gather`` priced on the SOURCE sizes), one
+    ``transfer`` hops grids (receive = the state's local fraction on
+    the DESTINATION sizes; free when replicated — survivors hold a
+    full copy), phase 1 carves destination shardings (``slice``,
+    free). ``states`` records (phase, axes) so divisibility is checked
+    against the right grid."""
+    ndim = len(src_axes)
+    out: List[Schedule] = []
+
+    def local(state: Tuple, sizes: Dict[str, int]) -> float:
+        return 1.0 / _parallelism(state, sizes)
+
+    def dfs_dst(state: Tuple, steps: Tuple[Step, ...],
+                comm: Dict[str, float], peak: float,
+                states: Tuple[Tuple, ...]) -> None:
+        if state == dst_axes:
+            out.append(Schedule(steps, dict(comm), peak, states))
+            return
+        if len(steps) >= 2 * ndim + 3 or len(out) >= 64:
+            return
+        used = {a for a in state if a is not None}
+        for i in range(ndim):
+            cur, want = state[i], dst_axes[i]
+            if cur is None and want is not None and want not in used:
+                nxt = state[:i] + (want,) + state[i + 1:]
+                dfs_dst(nxt, steps + (Step("slice", i, want),),
+                        comm, max(peak, local(nxt, dst_sizes)),
+                        states + (("dst", nxt),))
+
+    def dfs_src(state: Tuple, steps: Tuple[Step, ...],
+                comm: Dict[str, float], peak: float,
+                states: Tuple[Tuple, ...]) -> None:
+        if len(out) >= 64:
+            return
+        # the transfer hop is legal from any state every destination
+        # axis of which is either already right or still carvable:
+        # phase 1 only ADDS shardings, never releases them
+        ok = all(c is None or c == w
+                 for c, w in zip(state, dst_axes))
+        if ok:
+            frac = (0.0 if all(a is None for a in state)
+                    else local(state, dst_sizes))
+            c = dict(comm)
+            if frac > 0:
+                c["transfer"] = c.get("transfer", 0.0) + frac
+            dfs_dst(state,
+                    steps + (Step("transfer", -1, "grid"),),
+                    c, max(peak, local(state, dst_sizes)),
+                    states + (("dst", state),))
+        if len(steps) >= ndim + 1:
+            return
+        for i in range(ndim):
+            cur = state[i]
+            if cur is None:
+                continue
+            # release this source-grid sharding (all_gather on src)
+            p = _axis_size(src_sizes, cur)
+            nxt = state[:i] + (None,) + state[i + 1:]
+            c = dict(comm)
+            c["all_gather"] = (c.get("all_gather", 0.0)
+                               + (p - 1) / _parallelism(state,
+                                                        src_sizes))
+            dfs_src(nxt, steps + (Step("all_gather", i, cur),),
+                    c, max(peak, local(nxt, src_sizes)),
+                    states + (("src", nxt),))
+
+    dfs_src(src_axes, (), {}, local(src_axes, src_sizes),
+            (("src", src_axes),))
+    return tuple(out)
+
+
+def cross_mesh_schedules(src: Tiling, src_sizes: Dict[str, int],
+                         dst: Tiling, dst_sizes: Dict[str, int]
+                         ) -> Tuple[Schedule, ...]:
+    """Every legal cross-grid decomposition of ``src`` on the
+    ``src_sizes`` grid -> ``dst`` on the ``dst_sizes`` grid. Empty for
+    rank mismatches and tuple-sharded (flat_row) axes — the step
+    vocabulary cannot peel a two-axis split, so those take the gather
+    fallback with a recorded reason (:func:`plan_transition`)."""
+    if len(src.axes) != len(dst.axes):
+        return ()
+    if any(isinstance(a, tuple) for a in src.axes + dst.axes):
+        return ()
+    key = (src.axes, dst.axes, tuple(sorted(src_sizes.items())),
+           tuple(sorted(dst_sizes.items())))
+    hit = _cross_memo.get(key)
+    if hit is None:
+        hit = _cross_memo[key] = _enumerate_cross(
+            src.axes, dst.axes, dict(src_sizes), dict(dst_sizes))
+    return hit
+
+
+def _divides(axes: Tuple, shape: Tuple[int, ...],
+             sizes: Dict[str, int]) -> bool:
+    for d, a in zip(shape, axes):
+        p = _axis_size(sizes, a)
+        if p > 1 and int(d) % p != 0:
+            return False
+    return True
+
+
+def plan_transition(src: Tiling, dst: Tiling,
+                    src_sizes: Dict[str, int],
+                    dst_sizes: Dict[str, int],
+                    shape: Tuple[int, ...], dtype: Any,
+                    factors: Optional[Dict[str, float]] = None
+                    ) -> MigrationDecision:
+    """Plan ONE cross-mesh-shape migration (elastic re-tiling): the
+    cheapest schedule and whether the direct repartition route is
+    safe, or the reasoned gather fallback. Never raises — migration
+    planning is advisory; the executor (``DistArray.rehome``,
+    checkpoint restore) always has the gather route."""
+    nbytes = float(int(np.prod(shape)) if shape else 1) \
+        * np.dtype(dtype).itemsize
+    same_grid = dict(src_sizes) == dict(dst_sizes)
+    if src.axes == dst.axes and same_grid:
+        return MigrationDecision(None, "noop", 0.0, 0.0,
+                                 "same tiling on the same grid")
+    if any(isinstance(a, tuple) for a in src.axes + dst.axes):
+        # flat_row and friends: a tuple-sharded axis needs a two-axis
+        # peel the step vocabulary cannot express — documented status
+        # (docs/REDISTRIBUTION.md), reasoned fallback, not a crash
+        p_src = _parallelism(src.axes, src_sizes)
+        moved = nbytes * (1.0 - 1.0 / max(p_src, 1))
+        return MigrationDecision(
+            None, "gather", moved, moved,
+            "tuple-sharded (flat_row) axes: outside the step "
+            "vocabulary; gather fallback")
+    scheds = cross_mesh_schedules(src, src_sizes, dst, dst_sizes)
+    if not scheds:
+        p_src = _parallelism(src.axes, src_sizes)
+        moved = nbytes * (1.0 - 1.0 / max(p_src, 1))
+        return MigrationDecision(
+            None, "gather", moved, moved,
+            "no cross-grid schedule (rank/axis mismatch): gather "
+            "fallback")
+    best = min(scheds, key=lambda s: (s.cost(nbytes, factors),
+                                      len(s.steps), s.describe()))
+    # divisibility per phase: pre-transfer states must divide on the
+    # SOURCE grid, post-transfer states on the DESTINATION grid — an
+    # indivisible intermediate means padded shards whose boundaries
+    # the direct repartition would mis-slice
+    for phase, axes in best.states:
+        sizes = src_sizes if phase == "src" else dst_sizes
+        if not _divides(axes, shape, sizes):
+            moved = best.comm_bytes(nbytes)
+            return MigrationDecision(
+                best, "gather", best.cost(nbytes, factors), moved,
+                f"indivisible intermediate {axes} on the "
+                f"{'survivor' if phase == 'dst' else 'source'} grid: "
+                "gather fallback")
+    moved = best.comm_bytes(nbytes)
+    return MigrationDecision(
+        best, "direct", best.cost(nbytes, factors), moved,
+        f"planned {best.describe()} "
+        f"(~{int(moved)} modeled wire bytes)")
+
+
+def plan_rehome(arr: Any, dst_mesh) -> Tuple[Tiling, MigrationDecision]:
+    """Plan one live array's migration onto ``dst_mesh`` (the elastic
+    recovery path): the destination tiling is the source tiling
+    sanitized for the survivor grid (axes that no longer divide are
+    dropped), the decision is :func:`plan_transition` under the active
+    calibration factors."""
+    from ..array import tiling as tiling_mod
+
+    shape = tuple(int(s) for s in arr.shape)
+    dst_t = tiling_mod.sanitize(arr.tiling, shape, dst_mesh)
+    dec = plan_transition(
+        arr.tiling, dst_t, {k: int(v) for k, v in arr.mesh.shape.items()},
+        {k: int(v) for k, v in dst_mesh.shape.items()},
+        shape, arr.dtype, _cal_factors())
+    return dst_t, dec
 
 
 def apply_schedule(val: Any, schedule: Schedule, src: Tiling,

@@ -50,8 +50,8 @@ from .store import Entry, PersistRejected, PersistStore
 
 __all__ = [
     "PersistStore", "PersistRejected", "Entry", "UnstableKeyError",
-    "active", "lookup", "maybe_store", "evict_stale", "prewarm",
-    "write_manifest", "stats", "reset",
+    "active", "lookup", "maybe_store", "maybe_gc", "evict_stale",
+    "prewarm", "write_manifest", "stats", "reset",
 ]
 
 _DIR_FLAG = FLAGS.define_str(
@@ -73,6 +73,18 @@ FLAGS.define_float(
     "Per-entry timeout for ServeEngine.prewarm: one slow or hostile "
     "entry cannot stall the rest of the prewarm set (the load keeps "
     "running in the background and is adopted if it finishes).")
+FLAGS.define_int(
+    "persist_max_bytes", 0,
+    "Size bound on the persist store (long-lived fleets): after each "
+    "persisted entry, least-recently-USED entries (manifest mtime — "
+    "refreshed on every load) are evicted until the store fits. "
+    "0 = unbounded (the default; entries then persist until "
+    "fingerprint rotation or dead-epoch eviction).")
+FLAGS.define_float(
+    "persist_ttl_s", 0.0,
+    "Age bound on persist-store entries: an entry not used (loaded) "
+    "for longer than this is evicted by the post-store GC sweep. "
+    "0 = no TTL.")
 
 # -- process-level store singleton ---------------------------------------
 
@@ -315,7 +327,32 @@ def maybe_store(plan: Any, executable: Any, mesh: Any) -> bool:
         _count("persist_stores")
         if plan.report is not None and plan.report.get("persist"):
             plan.report["persist"]["stored"] = True
+        maybe_gc(protect=(digest,))
     return landed
+
+
+def maybe_gc(protect: Tuple[str, ...] = ()) -> int:
+    """Apply the store's size/TTL bounds (``FLAGS.persist_max_bytes``
+    / ``persist_ttl_s``, LRU-by-mtime) after a store landed. No-raise,
+    two flag reads when unbounded; evictions land in the
+    ``persist_evictions`` counter."""
+    max_bytes = int(FLAGS.persist_max_bytes or 0)
+    ttl_s = float(FLAGS.persist_ttl_s or 0.0)
+    if not max_bytes and not ttl_s:
+        return 0
+    store = active()
+    if store is None:
+        return 0
+    try:
+        n = store.gc(max_bytes, ttl_s, protect=tuple(protect))
+    except Exception as e:  # noqa: BLE001 - GC is hygiene, never a
+        # reason to fail the evaluation that triggered it
+        log_warn("persist: GC sweep failed (%s: %s)",
+                 type(e).__name__, str(e)[:120])
+        return 0
+    if n:
+        _count("persist_evictions", n)
+    return n
 
 
 # -- eviction -------------------------------------------------------------

@@ -206,6 +206,12 @@ class PersistStore:
             # never a crashed evaluate
             raise PersistRejected(
                 "deserialize", f"{type(e).__name__}: {e}")
+        try:
+            # refresh recency: the GC policy evicts LRU-by-mtime, so a
+            # served (hot) entry must not age out while it is in use
+            os.utime(mpath)
+        except OSError:
+            pass
         return Entry(digest, compiled, plan_meta)
 
     # -- save (lease writer) -------------------------------------------
@@ -290,6 +296,74 @@ class PersistStore:
                 pass
 
     # -- eviction / hygiene --------------------------------------------
+
+    def entry_stats(self) -> List[Tuple[float, int, str]]:
+        """(mtime, bytes, digest) per committed entry — the GC's
+        LRU-by-mtime view. mtime is the manifest's (touched on every
+        successful load, so recency tracks USE, not just creation)."""
+        out: List[Tuple[float, int, str]] = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith("entry_") or "." in name:
+                continue
+            edir = os.path.join(self.root, name)
+            mpath = os.path.join(edir, _MANIFEST)
+            try:
+                mtime = os.path.getmtime(mpath)
+                size = sum(
+                    os.path.getsize(os.path.join(edir, f))
+                    for f in os.listdir(edir))
+            except OSError:
+                continue  # raced an eviction/purge
+            out.append((mtime, int(size), name[len("entry_"):]))
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(b for _, b, _ in self.entry_stats())
+
+    def gc(self, max_bytes: int = 0, ttl_s: float = 0.0,
+           protect: Tuple[str, ...] = ()) -> int:
+        """Bound the store (long-lived fleets): drop entries older
+        than ``ttl_s`` (by manifest mtime — refreshed on use), then
+        evict LRU-by-mtime until the store fits ``max_bytes``. 0
+        disables either bound; ``protect`` digests (the entry a
+        caller just wrote) are never evicted. Returns entries
+        evicted. Best-effort: concurrent writers may race individual
+        rmtrees, which is fine — eviction of an already-gone entry is
+        a no-op."""
+        if not max_bytes and not ttl_s:
+            return 0
+        entries = sorted(self.entry_stats())  # oldest first
+        now = time.time()
+        evicted = 0
+        live: List[Tuple[float, int, str]] = []
+        for mtime, size, digest in entries:
+            if digest in protect:
+                live.append((mtime, size, digest))
+                continue
+            if ttl_s and now - mtime > ttl_s:
+                self.purge(digest)
+                evicted += 1
+            else:
+                live.append((mtime, size, digest))
+        if max_bytes:
+            total = sum(s for _, s, _ in live)
+            for mtime, size, digest in live:
+                if total <= max_bytes:
+                    break
+                if digest in protect:
+                    continue
+                self.purge(digest)
+                total -= size
+                evicted += 1
+        if evicted:
+            log_warn("persist: GC evicted %d entr%s "
+                     "(max_bytes=%s, ttl_s=%s)", evicted,
+                     "y" if evicted == 1 else "ies", max_bytes, ttl_s)
+        return evicted
 
     def purge(self, digest: str) -> None:
         """Drop one entry (best-effort; used when a restored

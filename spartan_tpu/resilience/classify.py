@@ -110,8 +110,12 @@ def classify(exc: BaseException) -> str:
     if kind is not None:  # injected faults label themselves, but their
         # messages ALSO match the patterns below; the attribute is just
         # the fast path (and covers hypothetical pattern drift)
+        # "recover" (a fault injected INSIDE elastic recovery, the
+        # chaos `recover` seam) classifies transient: the triggering
+        # operation retries, re-enters the idempotent recovery, and
+        # finishes it
         return {"transient": TRANSIENT, "oom": OOM, "io": IO,
-                "device_loss": FATAL_MESH,
+                "device_loss": FATAL_MESH, "recover": TRANSIENT,
                 "compile": DETERMINISTIC}.get(kind, DETERMINISTIC)
     if isinstance(exc, FatalMeshError):
         return FATAL_MESH

@@ -37,9 +37,24 @@ is NOT: un-checkpointed state whose shards died with the device — the
 
 Recovery is idempotent per epoch: concurrent fatal failures from
 several serve workers trigger ONE drain/rebuild/evict (the losers
-observe the bumped epoch and return). ``FLAGS.elastic_recovery=False``
-turns the rung off — fatal mesh errors then fail fast like
+observe the bumped epoch and return) — and idempotent UNDER CHAOS:
+every phase probes the ``recover`` fault seam
+(``resilience/faults``), and a recovery killed mid-flight (after the
+rebuild bumped the epoch but before eviction/resume) is FINISHED by
+the next ``handle_failure`` — completion is tracked per epoch
+(``_completed_epoch``), so the idempotent tail (evict + reopen
+admission) re-runs until it lands and a second ``handle_failure`` for
+an already-recovered epoch is a no-op. ``FLAGS.elastic_recovery=
+False`` turns the rung off — fatal mesh errors then fail fast like
 deterministic ones.
+
+Migration is PLANNED: :func:`rehome` routes every stale array through
+``parallel/redistribute.plan_rehome`` (cross-mesh-shape schedules,
+docs/REDISTRIBUTION.md), records per-array schedule/bytes/route/
+reason on the array and in the ``elastic_rehome`` span, feeds
+``elastic_migrated_bytes`` / ``elastic_rehomed`` /
+``elastic_rehome_skipped``, and skips donated (invalidated) handles
+with a labeled reason instead of crashing on them.
 """
 
 from __future__ import annotations
@@ -71,8 +86,31 @@ FLAGS.define_float(
 
 _lock = threading.Lock()
 
+# The highest mesh epoch whose recovery FINISHED (evict + admission
+# reopen included). rebuild_mesh bumps the epoch mid-recovery, so a
+# chaos fault between the bump and the eviction leaves
+# _completed_epoch behind — the next on_fatal_mesh call detects the
+# gap and runs the idempotent tail instead of treating the bumped
+# epoch as fully recovered. ``_pending`` is True only while a
+# recovery is actually in flight, so a MANUAL rebuild_mesh (planned
+# reshape, tests) never reads as an interrupted recovery.
+_completed_epoch = 0
+_pending = False
+
+# last rehome pass's per-array migration records (tests/benchmarks)
+_last_rehome: list = []
+
 # "device 3", "device: 3", "TPU_4" etc. in real status messages
 _DEV_RE = re.compile(r"device[:\s#]*(\d+)", re.IGNORECASE)
+
+
+def _fire_recover() -> None:
+    """The ``recover`` chaos seam (resilience/faults): one module-
+    attribute read when no plan is installed."""
+    from . import faults as faults_mod
+
+    if faults_mod._ACTIVE is not None:
+        faults_mod.fire("recover")
 
 
 def _count(name: str, help_: str, n: int = 1) -> None:
@@ -115,31 +153,87 @@ def _drain_serve(retry_after_s: float) -> int:
     return eng.drain_reconfiguring(retry_after_s)
 
 
+def _finish_recovery(epoch: int) -> Any:
+    """The idempotent tail of a recovery that died mid-flight (chaos
+    injected between the epoch bump and eviction): evict the dead
+    epochs' plans, reopen admission, mark the epoch complete. Caller
+    holds ``_lock``."""
+    global _completed_epoch, _pending
+    from ..expr import base as expr_base
+
+    with prof.span("elastic_recover", epoch=epoch, resumed=True) as sp:
+        with prof.phase("evict"):
+            _fire_recover()
+            evicted = expr_base.evict_stale_plans()
+            persisted = persist_mod.last_evicted()
+        sp.set(evicted=evicted, persist_evicted=persisted)
+    _completed_epoch = epoch
+    _pending = False
+    _resume_serve()
+    _count("elastic_recoveries_resumed",
+           "recoveries finished by a later handle_failure after a "
+           "mid-recovery fault")
+    _count("elastic_plans_evicted",
+           "dead-epoch plans evicted during elastic recovery", evicted)
+    log_warn("elastic: finished interrupted recovery for mesh epoch "
+             "%d — %d plan(s) evicted (+%d persisted), admission "
+             "reopened", epoch, evicted, persisted)
+    return mesh_mod.get_mesh()
+
+
 def on_fatal_mesh(exc: BaseException, mesh: Any = None) -> Optional[Any]:
     """Executed by the policy engine when a dispatch failure classifies
-    ``fatal_mesh``: drain → rebuild → evict, idempotent per epoch.
+    ``fatal_mesh``: drain → rebuild → evict, idempotent per epoch AND
+    under chaos injected mid-recovery (the ``recover`` fault seam).
 
-    Returns the rebuilt mesh (or the current one, when another thread
-    already recovered this epoch); None when elastic recovery is
-    disabled. The caller still raises — the failed evaluation itself
-    is not replayable (its inputs live on the dead mesh); recovery
-    makes the NEXT dispatch (a loop's restored segment, a client's
-    resubmission) land on a live mesh."""
+    Returns the rebuilt mesh (or the current one, when this epoch is
+    already recovered — a second ``handle_failure`` for the same
+    epoch is a no-op); None when elastic recovery is disabled. The
+    caller still raises — the failed evaluation itself is not
+    replayable (its inputs live on the dead mesh); recovery makes the
+    NEXT dispatch (a loop's restored segment, a client's resubmission)
+    land on a live mesh."""
+    global _completed_epoch, _pending
     if not FLAGS.elastic_recovery:
         return None
     seen_epoch = mesh_mod._EPOCH
     with _lock:
+        if _completed_epoch > mesh_mod._EPOCH:
+            _completed_epoch = 0  # epoch reset (test isolation)
+            _pending = False
         if mesh_mod._EPOCH != seen_epoch:
             # another worker's recovery already rebuilt past the epoch
             # this failure was dispatched under
-            return mesh_mod.get_mesh()
+            if not _pending or _completed_epoch >= mesh_mod._EPOCH:
+                return mesh_mod.get_mesh()
+            # ... but it died before finishing (chaos mid-recovery):
+            # run the idempotent tail — evict + reopen admission
+            return _finish_recovery(mesh_mod._EPOCH)
         lost = infer_failed_devices(exc)
+        already = set(mesh_mod._excluded_ids)
+        if lost and all(d in already for d in lost):
+            # this casualty set was already excluded by an earlier
+            # recovery: a second handle_failure for the same loss
+            # (another worker replaying the same epoch's failure) is a
+            # NO-OP — unless that recovery died mid-flight, in which
+            # case only its idempotent tail runs
+            if not _pending or _completed_epoch >= mesh_mod._EPOCH:
+                return mesh_mod.get_mesh()
+            return _finish_recovery(mesh_mod._EPOCH)
         retry_after = FLAGS.elastic_retry_after_s
+        _pending = True
         with prof.span("elastic_recover", epoch=seen_epoch,
                        lost=tuple(lost)) as sp:
             with prof.phase("drain"):
+                _fire_recover()
                 drained = _drain_serve(retry_after)
             with prof.phase("rebuild"):
+                # a fault HERE leaves the epoch unbumped: the next
+                # handle_failure re-runs the whole recovery (drain is
+                # re-entrant); a fault AFTER rebuild_mesh leaves
+                # _completed_epoch behind the bumped epoch, and the
+                # next handle_failure runs _finish_recovery
+                _fire_recover()
                 new_mesh = mesh_mod.rebuild_mesh(exclude_devices=lost)
             from ..expr import base as expr_base
 
@@ -148,11 +242,16 @@ def on_fatal_mesh(exc: BaseException, mesh: Any = None) -> Optional[Any]:
                 # entries of the dead epoch (spartan_tpu/persist) —
                 # without the disk half, a later restart would
                 # resurrect plans for the mesh that just died
+                _fire_recover()
                 evicted = expr_base.evict_stale_plans()
                 persisted = persist_mod.last_evicted()
             sp.set(drained=drained, evicted=evicted,
                    persist_evicted=persisted,
-                   survivors=int(new_mesh.devices.size))
+                   survivors=int(new_mesh.devices.size),
+                   from_shape=mesh_mod.mesh_shape_at(seen_epoch),
+                   to_shape={k: int(v) for k, v in new_mesh.shape.items()})
+        _completed_epoch = mesh_mod._EPOCH
+        _pending = False
         _count("elastic_recoveries",
                "fatal mesh failures recovered by drain/rebuild/evict")
         _count("elastic_plans_evicted",
@@ -178,18 +277,84 @@ def _resume_serve() -> None:
 
 
 def rehome(arrays: Sequence[Any]) -> int:
-    """Migrate stale-epoch DistArrays onto the current mesh (host
-    round-trip, in place — see ``DistArray.rehome``). The loop driver
-    calls this with ``StaleMeshError.arrays`` after a recovery, so a
-    body closure's captured leaves (the k-means points) follow the
-    carries onto the shrunken mesh. Returns arrays migrated."""
-    n = 0
-    for arr in arrays:
-        if getattr(arr, "_epoch", None) != mesh_mod._EPOCH:
-            arr.rehome()
-            n += 1
+    """Migrate stale-epoch DistArrays onto the current mesh through
+    the PLANNED migration pipeline (``DistArray.rehome`` ->
+    ``parallel/redistribute.plan_rehome``): per-array schedule, route
+    (direct repartition vs gather fallback), modeled wire bytes and
+    reason land on each array's ``_migration`` record, in the
+    ``elastic_rehome`` span and in the ``elastic_*`` metrics. The loop
+    driver calls this with ``StaleMeshError.arrays`` after a recovery,
+    so a body closure's captured leaves (the k-means points) follow
+    the carries onto the shrunken mesh.
+
+    Donated (invalidated) handles are SKIPPED with a labeled reason —
+    their buffers are gone by contract and must not crash the healing
+    of the arrays that still have one. Returns arrays migrated."""
+    global _last_rehome
+    if _pending and FLAGS.elastic_recovery:
+        # a recovery died between its epoch bump and its eviction
+        # (chaos mid-recovery): any elastic entry point finishes the
+        # idempotent tail, so loops that heal through rehome alone
+        # still leave the caches evicted and admission reopened
+        with _lock:
+            if _pending and _completed_epoch < mesh_mod._EPOCH:
+                _finish_recovery(mesh_mod._EPOCH)
+    n = skipped = 0
+    total_bytes = 0
+    records = []
+    with prof.span("elastic_rehome", arrays=len(arrays)) as sp:
+        with prof.phase("migrate"):
+            _fire_recover()
+            for arr in arrays:
+                arr = getattr(arr, "value", arr)  # unwrap ValExpr
+                if getattr(arr, "_jax", True) is None:
+                    arr.rehome()  # records the labeled skip
+                    skipped += 1
+                    records.append(getattr(arr, "_migration", None)
+                                   or {"route": "skipped"})
+                    continue
+                if getattr(arr, "_epoch", None) != mesh_mod._EPOCH:
+                    arr.rehome()
+                    n += 1
+                    mig = getattr(arr, "_migration", None)
+                    if mig:
+                        total_bytes += int(mig.get("bytes", 0))
+                        records.append(mig)
+        sp.set(migrated=n, skipped=skipped, bytes=total_bytes,
+               routes=tuple(sorted({r.get("route", "?")
+                                    for r in records})) or None)
+    _last_rehome = records
     if n:
         _count("elastic_rehomed",
                "stale-epoch DistArrays migrated onto the rebuilt "
                "mesh", n)
+    if skipped:
+        _count("elastic_rehome_skipped",
+               "donated/invalidated handles skipped (with reason) "
+               "during a rehome pass", skipped)
+    if total_bytes:
+        _count("elastic_migrated_bytes",
+               "modeled wire bytes of planned cross-mesh migrations "
+               "(rehome + checkpoint restore)", total_bytes)
     return n
+
+
+def note_migrations(records: Sequence[Any]) -> None:
+    """Fold externally-executed planned migrations (checkpoint-restore
+    re-tiles from ``resilience/loop_ckpt``) into the same ``elastic_*``
+    metrics family the rehome pass feeds."""
+    total = sum(int(r.get("bytes", 0)) for r in records if r)
+    if records:
+        _count("elastic_restore_migrations",
+               "loop carries re-tiled through the migration planner "
+               "on checkpoint restore", len([r for r in records if r]))
+    if total:
+        _count("elastic_migrated_bytes",
+               "modeled wire bytes of planned cross-mesh migrations "
+               "(rehome + checkpoint restore)", total)
+
+
+def last_rehome_report() -> list:
+    """Per-array migration records of the most recent rehome pass
+    (route / schedule / bytes / reason) — test & benchmark surface."""
+    return list(_last_rehome)

@@ -21,6 +21,15 @@ Injection sites (the real seams):
   miss consumes no occurrence). Fault: ``io`` (an ``OSError``) —
   checkpoint faults surface to the caller's recovery policy, persist
   faults degrade to a normal recompile / skipped persist.
+* ``recover`` — INSIDE elastic recovery itself
+  (``resilience/elastic``): the drain / rebuild / evict phases of
+  ``on_fatal_mesh`` and each ``elastic.rehome`` migration pass probe
+  this seam, so chaos can kill a recovery MID-FLIGHT and prove the
+  next ``handle_failure`` re-enters cleanly (recovery is idempotent
+  per epoch — the chaos-during-recovery contract,
+  docs/RESILIENCE.md). Fault: ``recover`` (an UNAVAILABLE-style
+  transient, so the policy layer retries the operation that
+  triggered recovery instead of failing it deterministically).
 
 Spec grammar (``FLAGS.fault_inject`` or ``st.chaos(spec)``): a
 comma-separated list of tokens::
@@ -40,6 +49,10 @@ comma-separated list of tokens::
                        error names the simulated casualty (the
                        highest-ordinal device) so the recovery path
                        exercises exclusion without a real dead chip.
+    recover@1          the second probe of the RECOVERY seam raises a
+                       transient fault — recovery itself dies mid-
+                       drain/rebuild/rehome, and the next
+                       handle_failure must finish it idempotently.
 
 Injected exceptions carry ``injected=True`` and messages matching the
 real-world patterns (``UNAVAILABLE``, ``RESOURCE_EXHAUSTED``,
@@ -109,6 +122,16 @@ class InjectedCheckpointError(OSError):
     fault_kind = "io"
 
 
+class InjectedRecoveryError(RuntimeError):
+    """Injected fault INSIDE elastic recovery (the ``recover`` seam):
+    an UNAVAILABLE-style transient, so the classifier sends the
+    triggering operation back through retry — which re-enters the
+    (idempotent) recovery and finishes it."""
+
+    injected = True
+    fault_kind = "recover"
+
+
 class InjectedDeviceLossError(RuntimeError):
     """Injected analogue of persistent device/host death (DATA_LOSS /
     halted-client status): classified ``fatal_mesh`` and routed into
@@ -152,13 +175,17 @@ _EXC = {
                 "(chaos {site}#{idx})"),
     "io": (InjectedCheckpointError,
            "injected checkpoint IO error (chaos {site}#{idx})"),
+    "recover": (InjectedRecoveryError,
+                "UNAVAILABLE: injected recovery fault (chaos "
+                "{site}#{idx})"),
     "device_loss": (InjectedDeviceLossError,
                     "DATA_LOSS: injected device loss: device {dev} "
                     "halted (client has been halted; chaos "
                     "{site}#{idx})"),
 }
 
-_KINDS = ("transient", "oom", "slow", "compile", "io", "device_loss")
+_KINDS = ("transient", "oom", "slow", "compile", "io", "device_loss",
+          "recover")
 _TOKEN = re.compile(
     r"^(?P<kind>[a-z_]+)"
     r"(?:@(?P<at>\d+))?"
@@ -222,6 +249,7 @@ class ChaosPlan:
         self._n_dispatch = 0
         self._n_compile = 0
         self._n_checkpoint = 0
+        self._n_recover = 0
 
     # -- occurrence counters ------------------------------------------
 
@@ -229,7 +257,8 @@ class ChaosPlan:
         with self._lock:
             return {"dispatch": self._n_dispatch,
                     "compile": self._n_compile,
-                    "checkpoint": self._n_checkpoint}
+                    "checkpoint": self._n_checkpoint,
+                    "recover": self._n_recover}
 
     def _record(self, spec: FaultSpec, site: str, idx: int) -> None:
         rec = {"kind": spec.kind, "site": site, "occurrence": idx}
@@ -248,10 +277,19 @@ class ChaosPlan:
         """Consult the plan at one injection site; raises (or sleeps,
         for ``slow``) when a token matches the current occurrence."""
         with self._lock:
+            rec_idx = None
             if site == "checkpoint":
                 ckpt_idx = self._n_checkpoint
                 self._n_checkpoint += 1
                 disp_idx = comp_idx = None
+            elif site == "recover":
+                # the recovery seam has its OWN occurrence space:
+                # recover@N addresses the N-th probe inside elastic
+                # recovery (drain/rebuild/evict/rehome), independent
+                # of how many dispatches preceded the failure
+                rec_idx = self._n_recover
+                self._n_recover += 1
+                ckpt_idx = disp_idx = comp_idx = None
             else:
                 disp_idx = self._n_dispatch
                 self._n_dispatch += 1
@@ -265,6 +303,8 @@ class ChaosPlan:
                 idx = ckpt_idx
             elif spec.kind == "compile":
                 idx = comp_idx
+            elif spec.kind == "recover":
+                idx = rec_idx
             else:  # transient / oom / slow fire on any executable run
                 idx = disp_idx
             if idx is None or not spec.hits(idx, self.seed):

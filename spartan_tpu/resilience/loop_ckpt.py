@@ -65,31 +65,58 @@ def _step_dir(path: str, step: int) -> str:
 
 
 def save_snapshot(path: str, step: int, carries: List[Any]) -> str:
-    """Atomically snapshot the carries after iteration ``step``."""
+    """Atomically snapshot the carries after iteration ``step``.
+
+    Multi-process (an N-process ``jax.distributed`` mesh running one
+    SPMD loop): every process writes its LOCAL shards straight into
+    the final step dir (``utils/checkpoint.save`` barriers per array
+    and rank 0 writes each array manifest), then rank 0 alone writes
+    ``loop_meta.json`` and the ``LATEST.json`` commit marker — the
+    single-process temp-dir + ``os.replace`` protocol would have every
+    rank promote a private temp dir holding only its own shards."""
+    import jax
+
     from ..utils import checkpoint as ckpt
 
     os.makedirs(path, exist_ok=True)
-    tmp = os.path.join(path, f".tmp_step_{step}_{os.getpid()}")
-    shutil.rmtree(tmp, ignore_errors=True)
+    multi = jax.process_count() > 1
+    final = _step_dir(path, step)
     with prof.span("loop_checkpoint", step=step):
-        ckpt.save_tree(tmp, {f"carry{i}": c
-                             for i, c in enumerate(carries)})
-        with open(os.path.join(tmp, "loop_meta.json"), "w") as f:
-            json.dump({"step": int(step), "carries": len(carries)}, f)
-        final = _step_dir(path, step)
-        shutil.rmtree(final, ignore_errors=True)
-        os.replace(tmp, final)
-        # LATEST.json is the commit marker: written (atomically) only
-        # after the snapshot dir landed, so a reader never sees a
-        # LATEST pointing at a partial snapshot
-        ltmp = os.path.join(path, f".{_LATEST}.{os.getpid()}")
-        with open(ltmp, "w") as f:
-            json.dump({"step": int(step),
-                       "dir": os.path.basename(final)}, f)
-        os.replace(ltmp, os.path.join(path, _LATEST))
+        if multi:
+            ckpt.save_tree(final, {f"carry{i}": c
+                                   for i, c in enumerate(carries)})
+            if jax.process_index() == 0:
+                with open(os.path.join(final, "loop_meta.json"),
+                          "w") as f:
+                    json.dump({"step": int(step),
+                               "carries": len(carries)}, f)
+                ltmp = os.path.join(path, f".{_LATEST}.{os.getpid()}")
+                with open(ltmp, "w") as f:
+                    json.dump({"step": int(step),
+                               "dir": os.path.basename(final)}, f)
+                os.replace(ltmp, os.path.join(path, _LATEST))
+        else:
+            tmp = os.path.join(path, f".tmp_step_{step}_{os.getpid()}")
+            shutil.rmtree(tmp, ignore_errors=True)
+            ckpt.save_tree(tmp, {f"carry{i}": c
+                                 for i, c in enumerate(carries)})
+            with open(os.path.join(tmp, "loop_meta.json"), "w") as f:
+                json.dump({"step": int(step),
+                           "carries": len(carries)}, f)
+            shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            # LATEST.json is the commit marker: written (atomically)
+            # only after the snapshot dir landed, so a reader never
+            # sees a LATEST pointing at a partial snapshot
+            ltmp = os.path.join(path, f".{_LATEST}.{os.getpid()}")
+            with open(ltmp, "w") as f:
+                json.dump({"step": int(step),
+                           "dir": os.path.basename(final)}, f)
+            os.replace(ltmp, os.path.join(path, _LATEST))
     _count("resilience_loop_checkpoints",
            "carry snapshots written by checkpointed st.loop")
-    _prune(path, keep=_KEEP_SNAPSHOTS)
+    if not multi or jax.process_index() == 0:
+        _prune(path, keep=_KEEP_SNAPSHOTS)
     return final
 
 
@@ -100,7 +127,12 @@ def _prune(path: str, keep: int) -> None:
 
 
 def load_latest(path: str) -> Optional[Tuple[int, List[Any]]]:
-    """(step, carries) of the last committed snapshot, or None."""
+    """(step, carries) of the last committed snapshot, or None.
+
+    A snapshot written on a different mesh grid restores through the
+    cross-mesh migration planner (``utils/checkpoint.load`` stamps a
+    ``_migration`` record per carry); :func:`_note_restore_migrations`
+    folds those into the loop record and the ``elastic_*`` metrics."""
     from ..utils import checkpoint as ckpt
 
     marker = os.path.join(path, _LATEST)
@@ -114,6 +146,25 @@ def load_latest(path: str) -> Optional[Tuple[int, List[Any]]]:
     tree = ckpt.load_tree(snap)
     carries = [tree[f"carry{i}"] for i in range(int(meta["carries"]))]
     return int(meta["step"]), carries
+
+
+def _note_restore_migrations(carries: List[Any],
+                             rec: Dict[str, Any]) -> None:
+    """Fold the restored carries' planned cross-mesh migrations (the
+    snapshot was written on a different grid) into the loop's
+    resilience record and the elastic metrics family."""
+    migs = [getattr(c, "_migration", None) for c in carries]
+    migs = [m for m in migs if m]
+    if not migs:
+        return
+    from . import elastic
+
+    rec.setdefault("migrations", []).extend(migs)
+    elastic.note_migrations(migs)
+    log_info("st.loop restore: %d carr%s re-tiled through the "
+             "migration planner (%d modeled wire bytes)", len(migs),
+             "y" if len(migs) == 1 else "ies",
+             sum(int(m.get("bytes", 0)) for m in migs))
 
 
 def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
@@ -148,6 +199,7 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
 
     start = 0
     carries: Optional[List[Any]] = None
+    restore_migs: List[Any] = []
     if resume is not None:
         latest = load_latest(resume) if os.path.isdir(resume) else None
         if latest is not None:
@@ -156,6 +208,8 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
                    "checkpointed loops resumed from a snapshot")
             log_info("st.loop resume: restored iteration %d from %s",
                      start, resume)
+            restore_migs = [c for c in carries
+                            if getattr(c, "_migration", None)]
         else:
             log_info("st.loop resume: no snapshot under %r; starting "
                      "fresh", resume)
@@ -168,6 +222,8 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
         "resumed_from": start if start else None,
         "restores": 0, "segments": 0, "retries": 0, "rung": None,
     }
+    if restore_migs:
+        _note_restore_migrations(restore_migs, rec)
     step = start
     restores = 0
     rehome_passes = 0
@@ -215,8 +271,25 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
                     from . import elastic
 
                     rehome_passes += 1
-                    if rehome_passes > 8 or not elastic.rehome(
-                            getattr(e, "arrays", ())):
+                    if rehome_passes > 8:
+                        raise
+                    try:
+                        healed = elastic.rehome(getattr(e, "arrays",
+                                                        ()))
+                    except Exception as re_exc:  # noqa: BLE001
+                        # chaos injected INSIDE the rehome pass (the
+                        # `recover` seam): a transient recovery fault
+                        # re-enters — the segment re-runs, raises
+                        # StaleMeshError again, and the next rehome
+                        # pass (fault consumed) heals. Anything
+                        # deterministic propagates.
+                        if cls.classify(re_exc) == cls.DETERMINISTIC:
+                            raise
+                        log_warn("st.loop: rehome pass failed (%s); "
+                                 "re-entering recovery",
+                                 str(re_exc)[:120])
+                        continue
+                    if not healed:
                         raise
                     rec["rehomed"] = (rec.get("rehomed", 0)
                                       + len(e.arrays))
@@ -253,10 +326,24 @@ def checkpointed_loop(n_iters: Any, body_fn: Any, init: Tuple[Any, ...],
                     except Exception:
                         pass
                     raise
-                latest = (load_latest(path)
-                          if path and os.path.isdir(path) else None)
+                try:
+                    latest = (load_latest(path)
+                              if path and os.path.isdir(path) else None)
+                except OSError as load_exc:
+                    # mid-restore IO fault (an `io` chaos token, or a
+                    # flaky filesystem): the snapshot on disk is still
+                    # intact (atomic-commit protocol) — fall through
+                    # to the held-carries re-run; the NEXT restore
+                    # attempt reads it again
+                    latest = None
+                    log_warn("st.loop: snapshot restore failed (%s); "
+                             "re-entering from held carries",
+                             str(load_exc)[:120])
                 if latest is not None:
                     step, carries = latest
+                    # carries written on the pre-loss grid restore as
+                    # planned migrations onto the rebuilt mesh
+                    _note_restore_migrations(carries, rec)
                     log_warn("st.loop: segment failed (%s); restored "
                              "iteration %d from checkpoint",
                              str(e)[:120], step)

@@ -296,7 +296,12 @@ class ServeEngine:
         the mesh rebuild so nothing else dispatches onto the dead
         mesh. Workers stay up (their in-flight failures are mapped to
         MeshReconfiguring by ``_solo``); ``resume_admission`` reopens
-        the door after the rebuild. Returns requests drained."""
+        the door after the rebuild. Returns requests drained.
+
+        Re-entrant: a recovery interrupted by a mid-recovery fault
+        (the chaos ``recover`` seam) re-drains on its next attempt —
+        draining an already-draining engine just empties whatever
+        queued since."""
         self._reconfiguring = float(retry_after_s)
         drained = self.queue.drain()
         for r in drained:
@@ -311,8 +316,17 @@ class ServeEngine:
         return len(drained)
 
     def resume_admission(self) -> None:
-        """Reopen admission after the mesh rebuild completed."""
+        """Reopen admission after the mesh rebuild completed.
+        Idempotent — the finish tail of an interrupted recovery calls
+        it again; reopening an open door is a no-op."""
+        if self._reconfiguring is None:
+            return
         self._reconfiguring = None
+        trace_mod.instant("serve_admission_reopened")
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "serve_admission_reopened",
+                "admission reopenings after elastic recovery").inc()
 
     # -- submission -----------------------------------------------------
 

@@ -186,26 +186,62 @@ def load(path: str, tiling: Optional[tiling_mod.Tiling] = None,
 
     Shards carrying a manifest CRC32 (every single-process save) are
     verified as read; a corrupt blob raises ``ValueError`` naming the
-    shard file."""
+    shard file.
+
+    Cross-mesh-shape restores (the checkpoint was written on a
+    different grid — an elastic shrink, or a world-size change across
+    restarts) are PLANNED migrations: the transition from the saved
+    tiling on the saved grid to the chosen tiling on the current grid
+    goes through ``parallel/redistribute.plan_transition``, and the
+    schedule / modeled wire bytes / reason land on the returned
+    array's ``_migration`` record (fed into ``elastic_*`` metrics by
+    the loop driver, and into ``st.explain``'s migrations section)."""
     _fire_checkpoint_fault()
     full, manifest = _load_host(path, nthreads)
+    saved_axes = _axes_from_json(manifest["tiling"])
     if tiling is None:
-        saved = _axes_from_json(manifest["tiling"])
-        t = tiling_mod.Tiling(saved)
+        t = tiling_mod.Tiling(saved_axes)
         t = tiling_mod.sanitize(t, full.shape)
     else:
         t = tiling
-    return da.from_numpy(full, tiling=t)
+    arr = da.from_numpy(full, tiling=t)
+    saved_mesh = {k: int(v)
+                  for k, v in (manifest.get("mesh") or {}).items()}
+    cur_mesh = {k: int(v) for k, v in arr.mesh.shape.items()}
+    if saved_mesh and saved_mesh != cur_mesh:
+        try:  # advisory: a migration record must never fail a load
+            from ..parallel import redistribute as redist_mod
+
+            dec = redist_mod.plan_transition(
+                tiling_mod.Tiling(saved_axes), arr.tiling,
+                saved_mesh, cur_mesh, full.shape, full.dtype)
+            arr._migration = {
+                "route": "restore", "bytes": int(dec.bytes),
+                "schedule": (dec.schedule.describe()
+                             if dec.schedule is not None else None),
+                "planned_route": dec.route, "reason": dec.reason,
+                "shape": tuple(full.shape),
+                "src_tiling": saved_axes, "dst_tiling": arr.tiling.axes,
+                "src_mesh": saved_mesh, "dst_mesh": cur_mesh,
+            }
+        except Exception:  # noqa: BLE001
+            pass
+    return arr
 
 
 def save_tree(path: str, arrays: Dict[str, Union[DistArray, np.ndarray]],
               nthreads: int = 8) -> None:
-    """Save a named collection (a model/driver state dict)."""
+    """Save a named collection (a model/driver state dict).
+
+    Multi-process: every rank writes its local shards (``save``
+    barriers per array); only rank 0 writes ``tree.json`` — identical
+    content, but N concurrent writers of one small file can tear."""
     os.makedirs(path, exist_ok=True)
     for name, arr in arrays.items():
         save(os.path.join(path, name), arr, nthreads)
-    with open(os.path.join(path, "tree.json"), "w") as f:
-        json.dump({"names": sorted(arrays)}, f)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "tree.json"), "w") as f:
+            json.dump({"names": sorted(arrays)}, f)
 
 
 def load_tree(path: str, nthreads: int = 8) -> Dict[str, DistArray]:

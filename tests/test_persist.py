@@ -511,3 +511,117 @@ def test_two_processes_share_one_cache_dir_concurrently(tmp_path):
     warm = json.loads(out.strip().splitlines()[-1])
     assert warm["compiles"] == 0 and warm["hits"] == 2, (warm, err)
     assert warm["bytes"] == results[0]["bytes"]
+
+
+# -- GC policy: size/TTL bounds (ISSUE 14 satellite) ----------------------
+
+
+def _age_entry(d, entry, seconds):
+    """Backdate an entry's manifest mtime (the GC's LRU clock)."""
+    mp = _manifest_path(d, entry)
+    old = os.path.getmtime(mp) - seconds
+    os.utime(mp, (old, old))
+
+
+def test_gc_off_by_default(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    assert st.FLAGS.persist_max_bytes == 0
+    assert st.FLAGS.persist_ttl_s == 0.0
+    for e in _plan_set():
+        e.evaluate().glom()
+    n = len(_entry_dirs(d))
+    assert n >= 2
+    assert persist.maybe_gc() == 0  # unbounded: sweep is a no-op
+    assert len(_entry_dirs(d)) == n
+
+
+def test_gc_ttl_evicts_stale_entries(mesh2d, tmp_path):
+    d = _fresh(tmp_path)
+    delta = _Delta()
+    for e in _plan_set():
+        e.evaluate().glom()
+    entries = _entry_dirs(d)
+    assert len(entries) >= 2
+    _age_entry(d, entries[0], seconds=3600)
+    st.FLAGS.persist_ttl_s = 60.0
+    try:
+        n = persist.maybe_gc()
+    finally:
+        st.FLAGS.persist_ttl_s = 0.0
+    assert n == 1
+    assert entries[0] not in _entry_dirs(d)
+    assert delta("persist_evictions") == 1
+
+
+def test_gc_size_bound_evicts_lru_first(mesh2d, tmp_path):
+    """Over the byte budget, the LEAST-recently-used entry (manifest
+    mtime) goes first; the freshly-stored entry is protected."""
+    d = _fresh(tmp_path)
+    for e in _plan_set():
+        e.evaluate().glom()
+    entries = _entry_dirs(d)
+    assert len(entries) >= 2
+    store = persist.active()
+    total = store.total_bytes()
+    # age the FIRST entry far back; bound the store so exactly one
+    # must go — LRU says the aged one
+    _age_entry(d, entries[0], seconds=1000)
+    sizes = {dg: b for _, b, dg in store.entry_stats()}
+    victim_digest = entries[0][len("entry_"):]
+    st.FLAGS.persist_max_bytes = total - 1
+    try:
+        n = persist.maybe_gc()
+    finally:
+        st.FLAGS.persist_max_bytes = 0
+    assert n >= 1
+    assert entries[0] not in _entry_dirs(d)
+    assert store.total_bytes() <= total - sizes[victim_digest]
+
+
+def test_gc_load_refreshes_recency(mesh2d, tmp_path):
+    """A USED entry does not age out: successful loads touch the
+    manifest mtime, so TTL eviction tracks use, not creation."""
+    d = _fresh(tmp_path)
+    exprs = _plan_set()
+    for e in exprs:
+        e.evaluate().glom()
+    entries = _entry_dirs(d)
+    for e in entries:
+        _age_entry(d, e, seconds=3600)
+    # a restart re-loads the first plan from disk -> refreshes it
+    _restart()
+    _plan_set()[0].evaluate().glom()
+    refreshed = [e for e in _entry_dirs(d)
+                 if os.path.getmtime(_manifest_path(d, e))
+                 > os.path.getmtime(_manifest_path(
+                     d, entries[0])) or e == entries[0]]
+    st.FLAGS.persist_ttl_s = 60.0
+    try:
+        persist.maybe_gc()
+    finally:
+        st.FLAGS.persist_ttl_s = 0.0
+    left = _entry_dirs(d)
+    assert len(left) == 1  # only the re-used entry survived the TTL
+
+
+def test_gc_runs_after_store_and_protects_new_entry(mesh2d, tmp_path):
+    """End to end: with a tiny byte budget, persisting the second plan
+    evicts the first (LRU) but never the entry just written."""
+    d = _fresh(tmp_path)
+    st.FLAGS.persist_max_bytes = 1  # nothing fits, newest protected
+    delta = _Delta()
+    try:
+        a, b = _plan_set()
+        a.evaluate().glom()
+        first = _entry_dirs(d)
+        assert len(first) == 1  # the just-written entry is protected
+        b.evaluate().glom()
+        second = _entry_dirs(d)
+        # the older entry was evicted; the newest survives its own GC
+        assert len(second) == 1 and second != first
+    finally:
+        st.FLAGS.persist_max_bytes = 0
+    assert delta("persist_evictions") >= 1
+    # results stay correct throughout (availability over reuse)
+    out = np.asarray(_plan_set()[0].evaluate().glom())
+    assert np.isfinite(out).all()
