@@ -15,7 +15,7 @@ hard part 5).
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, List, Optional, Sequence, Union
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,61 @@ REDUCERS = {
     "max": "max",
     "min": "min",
 }
+
+_COMBINE = {
+    "add": jnp.add,
+    "multiply": jnp.multiply,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+}
+
+# update() dispatches through ONE jitted program per (op, sharding,
+# rank): region starts are traced scalars, so a stream of region
+# writes (the incremental engine's mutation seam — sliding windows,
+# rotating edge batches) compiles once per data shape instead of once
+# per call site/region. The write itself is a mask + clipped-gather
+# select rather than a dynamic_update_slice: GSPMD can only lower a
+# traced-start DUS on a sharded dim by gathering the whole operand
+# (~20x the cost of the write), while iota-mask/where/gather-of-the-
+# small-delta all partition cleanly.
+_UPDATE_JIT: dict = {}
+_UPDATE_JIT_MAX = 512
+
+
+def _update_callable(op: str, sharding: NamedSharding,
+                     delta_sharding: NamedSharding, ndim: int):
+    key = (op, sharding, delta_sharding, ndim)
+    fn = _UPDATE_JIT.get(key)
+    if fn is None:
+        from jax import lax
+
+        def _apply(x, d, *starts):
+            ixs = [lax.broadcasted_iota(jnp.int32, x.shape, ax)
+                   - starts[ax] for ax in range(x.ndim)]
+            inb = None
+            for ax, ix in enumerate(ixs):
+                m = (ix >= 0) & (ix < d.shape[ax])
+                inb = m if inb is None else (inb & m)
+            dfull = d[tuple(jnp.clip(ix, 0, d.shape[ax] - 1)
+                            for ax, ix in enumerate(ixs))]
+            val = dfull if op == "set" else _COMBINE[op](x, dfull)
+            # second output: the post-write region values for op "set"
+            # — the incremental engine's stash (byte-identical to the
+            # committed region; combine reducers don't stash, their
+            # post-write values only exist inside the full array)
+            return jnp.where(inb, val, x), d
+
+        fn = jax.jit(_apply, out_shardings=(sharding, delta_sharding))
+        if len(_UPDATE_JIT) >= _UPDATE_JIT_MAX:
+            _UPDATE_JIT.clear()
+        _UPDATE_JIT[key] = fn
+    return fn
+
+
+def _stash_enabled() -> bool:
+    from ..utils.config import FLAGS
+
+    return bool(getattr(FLAGS, "incremental", False))
 
 
 def _canonical_reducer(reducer: Any) -> str:
@@ -71,12 +126,108 @@ def _caller_site():
     return None
 
 
+_MUTLOG_MAX = 256  # mutation-log cap: overflow collapses to whole-array
+
+
+class Lineage:
+    """Shared mutation history of a family of :class:`DistArray` handles.
+
+    ``update()`` is functional — it returns a NEW DistArray — but the
+    returned array shares its parent's ``Lineage`` so the incremental
+    engine (expr/incremental.py) can tell *what moved* between the leaf
+    a result cache entry recorded and the leaf a later evaluate sees:
+    same lineage + a higher version means "this array, with exactly the
+    extents logged in between dirty"; anything else is a new identity
+    and the engine falls back to a full recompute. The log is bounded:
+    past ``_MUTLOG_MAX`` entries it collapses to one whole-array marker
+    (``None`` extent), which is the conservative (always-correct)
+    over-approximation."""
+
+    __slots__ = ("log", "latest", "stash", "stash_bytes")
+
+    # post-write region values kept per logged entry (the incremental
+    # engine serves restricted leaves from these instead of dynamic-
+    # slicing the sharded parent, which GSPMD lowers to a gather)
+    _STASH_MAX_BYTES = 64 << 20
+
+    def __init__(self) -> None:
+        # log: [(version, TileExtent | None)] — None means whole array
+        self.log: List[Tuple[int, Optional[TileExtent]]] = []
+        self.latest = 0
+        self.stash: dict = {}  # version -> jax.Array (region values)
+        self.stash_bytes = 0
+
+    def note(self, ext: Optional[TileExtent],
+             value: Optional[jax.Array] = None) -> int:
+        self.latest += 1
+        if len(self.log) >= _MUTLOG_MAX:
+            self.log = [(self.latest, None)]
+            self.stash.clear()
+            self.stash_bytes = 0
+        else:
+            self.log.append((self.latest, ext))
+            if value is not None and ext is not None:
+                nb = int(value.size) * value.dtype.itemsize
+                if nb <= self._STASH_MAX_BYTES:
+                    self.stash[self.latest] = value
+                    self.stash_bytes += nb
+                    while self.stash_bytes > self._STASH_MAX_BYTES:
+                        v = next(iter(self.stash))
+                        old = self.stash.pop(v)
+                        self.stash_bytes -= (int(old.size)
+                                             * old.dtype.itemsize)
+        return self.latest
+
+    def stashed_between(self, v0: int, v1: int
+                        ) -> Optional[Tuple[TileExtent, jax.Array]]:
+        """The post-write values of the delta — available iff EXACTLY
+        one write landed in ``v0 < version <= v1`` and its values were
+        stashed (stashes of sequential writes don't compose: the later
+        region's values may overlap the earlier)."""
+        found = None
+        for v, ext in self.log:
+            if v0 < v <= v1:
+                if found is not None:
+                    return None
+                found = (v, ext)
+        if found is None:
+            return None
+        v, ext = found
+        val = self.stash.get(v)
+        if ext is None or val is None:
+            return None
+        return ext, val
+
+    def dirty_between(self, v0: int, v1: int,
+                      shape: tuple) -> Optional[TileExtent]:
+        """Bounding box of extents logged with ``v0 < version <= v1``;
+        ``None`` means the whole array (a full marker, a dropped entry,
+        or no box algebra possible)."""
+        box: Optional[TileExtent] = None
+        seen = 0
+        for v, ext in self.log:
+            if v0 < v <= v1:
+                seen += 1
+                if ext is None:
+                    return None
+                if box is None:
+                    box = ext
+                else:
+                    box = TileExtent(
+                        tuple(min(a, b) for a, b in zip(box.ul, ext.ul)),
+                        tuple(max(a, b) for a, b in zip(box.lr, ext.lr)),
+                        shape)
+        if seen == 0 and v1 > v0:
+            return None  # versions fell off the bounded log
+        return box
+
+
 class DistArray:
     """A distributed N-d array: ``jax.Array`` + :class:`Tiling` over the
     ambient mesh."""
 
     __slots__ = ("_jax", "tiling", "mesh", "_donate_next", "_donate_site",
-                 "_epoch", "_migration")
+                 "_epoch", "_migration", "_lineage", "_version")
 
     def __init__(self, jax_array: jax.Array, tiling: Tiling,
                  mesh: Optional[Mesh] = None):
@@ -87,6 +238,8 @@ class DistArray:
         self._donate_next = False
         self._donate_site = None
         self._migration = None  # planned cross-mesh migration record
+        self._lineage = None  # mutation history (update/assign seam)
+        self._version = 0
         self.tiling = tiling
         self.mesh = mesh or mesh_mod.get_mesh()
         # birth epoch: using this array after a rebuild_mesh (its
@@ -205,22 +358,53 @@ class DistArray:
         through worker RPCs with reducer-merge (SURVEY.md §2.2); here it is
         a functional scatter-combine, deterministic by construction
         (SURVEY.md §7 hard part 3).
+
+        This is also the mutation seam of the incremental engine
+        (docs/INCREMENTAL.md): the returned array shares this array's
+        :class:`Lineage` with ``region`` logged as its dirty extent, so
+        a warm ``evaluate()`` whose plan-cache key still hits (leaf
+        signatures are positional, not identity-based) can recompute
+        only what the update touched.
         """
         if not isinstance(region, TileExtent):
             region = extent_mod.from_slice(region, self.shape)
-        op = _canonical_reducer(reducer)
+        op = REDUCERS[_canonical_reducer(reducer)]
         data = jnp.asarray(data, dtype=self.dtype)
         if data.shape != region.shape:
             data = jnp.broadcast_to(data, region.shape)
-        sl = region.to_slice()
+        # the delta output keeps the parent's sharding on axes the
+        # region takes whole and replicates cut axes — the same rule as
+        # the engine's DynSliceExpr, so a stash-served restricted
+        # program has the identical partial-sum structure (bit-equality
+        # with the full recompute)
+        dt = self.tiling
+        for ax, (u, l, s) in enumerate(zip(region.ul, region.lr,
+                                           self.shape)):
+            if not (u == 0 and l == s):
+                dt = dt.with_axis(ax, None)
+        fn = _update_callable(op, self.sharding(), dt.sharding(self.mesh),
+                              self.ndim)
+        starts = [jnp.asarray(u, jnp.int32) for u in region.ul]
+        out, delta = fn(self.jax_array, data, *starts)
+        res = DistArray(out, self.tiling, self.mesh)
+        stash = delta if (op == "set" and _stash_enabled()) else None
+        self._record_mutation(res, region, stash)
+        return res
 
-        def _apply(x, d):
-            ref = x.at[sl]
-            return getattr(ref, op)(d)
-
-        out = jax.jit(_apply, out_shardings=self.sharding())(
-            self.jax_array, data)
-        return DistArray(out, self.tiling, self.mesh)
+    def _record_mutation(self, child: "DistArray",
+                         region: Optional[TileExtent],
+                         value: Optional[jax.Array] = None) -> None:
+        """Thread this array's lineage through a functionally-updated
+        child: ``region`` (or whole-array when ``None``) becomes the
+        delta between ``self``'s version and ``child``'s, with the
+        post-write region ``value`` stashed when available."""
+        lin = self._lineage
+        if lin is None:
+            lin = Lineage()
+            lin.latest = self._version
+            self._lineage = lin
+        child._lineage = lin
+        child._version = lin.note(region, value)
 
     # -- resharding -----------------------------------------------------
 

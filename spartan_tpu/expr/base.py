@@ -987,6 +987,9 @@ def evict_stale_plans() -> int:
     # resurrect plans for a mesh that no longer exists. No-op (one
     # flag read) with the store off; never raises.
     persist_mod.evict_stale()
+    # the incremental engine's result cache holds device buffers keyed
+    # by plan: entries born under the dead epoch go with their plans
+    incremental_mod.evict_stale()
     return evicted
 
 
@@ -1219,6 +1222,7 @@ def _wrap_result(expr: Expr, plan: _Plan, out: Any,
                     don["donated_dispatches"] = (
                         don.get("donated_dispatches", 0) + 1)
         expr._result = result
+        _maybe_record_write(expr, result)
     if numerics_mod._WATCHPOINTS:
         # persistent data-health watchpoints (st.watch): re-check each
         # after every dispatch; the empty-list read above is the whole
@@ -1311,6 +1315,31 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
     return _wrap_result(expr, plan, out, darrs, dpos, mesh)
 
 
+_write_expr_cls = None  # lazily-bound assign.WriteExpr (import cycle)
+
+
+def _maybe_record_write(expr: Expr, result: Any) -> None:
+    """The assign-expr mutation seam: evaluating ``st.assign(arr, idx,
+    v)`` (a WriteExpr over a concrete array) is a functional update of
+    that array — stamp the result into the source's Lineage exactly
+    like ``DistArray.update()`` does, so the incremental engine sees
+    the written region as the only delta."""
+    global _write_expr_cls
+    if _write_expr_cls is None:
+        if type(expr).__name__ != "WriteExpr":
+            return
+        from .assign import WriteExpr
+
+        _write_expr_cls = WriteExpr
+    if not isinstance(expr, _write_expr_cls):
+        return
+    dst = expr.dst
+    if (isinstance(dst, ValExpr) and isinstance(dst.value, DistArray)
+            and isinstance(result, DistArray)
+            and result.shape == dst.value.shape):
+        dst.value._record_mutation(result, expr.region)
+
+
 _engine_mod = None  # lazily-bound resilience.engine (cold path only)
 
 
@@ -1381,13 +1410,29 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
                         expr, plan, donated, mesh)
                     if gov is not memory_mod.NOT_HANDLED:
                         return gov
+                if incremental_mod._INC_FLAG._value:
+                    # delta-aware path (expr/incremental.py): serve
+                    # from the result cache + a dirty sub-plan when
+                    # lineage proves most tiles clean; NOT_HANDLED
+                    # falls through to the ordinary full dispatch
+                    inc = incremental_mod.intercept(
+                        expr, plan, rctx.leaves, plan.arg_order,
+                        donated, mesh)
+                    if inc is not incremental_mod.NOT_HANDLED:
+                        expr._result = inc
+                        return inc
                 try:
-                    return _dispatch(expr, plan, rctx.leaves,
-                                     plan.arg_order, donated, mesh)
+                    result = _dispatch(expr, plan, rctx.leaves,
+                                       plan.arg_order, donated, mesh)
                 except Exception as e:
-                    return _handle_failure(e, expr, plan, rctx.leaves,
-                                           plan.arg_order, donated,
-                                           mesh)
+                    result = _handle_failure(e, expr, plan, rctx.leaves,
+                                             plan.arg_order, donated,
+                                             mesh)
+                if incremental_mod._INC_FLAG._value:
+                    incremental_mod.note_result(
+                        plan, rctx.leaves, plan.arg_order, result,
+                        donated, mesh)
+                return result
             prof.count("plan_misses")
             esp.set(cache="miss")
 
@@ -1428,6 +1473,9 @@ def evaluate(expr: Expr, donate: Sequence[Any] = ()) -> DistArray:
             result = _handle_failure(e, expr, plan, leaves,
                                      plan.arg_order, donated, mesh)
         dag._result = result
+        if incremental_mod._INC_FLAG._value:
+            incremental_mod.note_result(plan, leaves, plan.arg_order,
+                                        result, donated, mesh)
         return result
 
 
@@ -1624,3 +1672,13 @@ def eval_shape_of(fn: Callable, *inputs: Expr, cache_key: Any = None,
     if key is not None and len(_eval_shape_cache) < 4096:
         _eval_shape_cache[key] = out
     return out
+
+
+# Bottom-bound seam (the persist_mod pattern): the incremental engine
+# (expr/incremental.py) needs every Expr type above to exist, and its
+# own expr imports are lazy, so binding it here closes the cycle. The
+# evaluate() paths read incremental_mod._INC_FLAG._value — one
+# attribute-chain read when FLAGS.incremental is off — and
+# benchmarks/incremental.py swaps this module binding for its
+# null-shim overhead arm (the warm_start.py persist_mod pattern).
+from . import incremental as incremental_mod  # noqa: E402

@@ -226,8 +226,19 @@ def snapshot(limit: Optional[int] = None) -> Dict[str, Any]:
         _n, lab = metrics_mod.parse_labels(key)
         tenants.setdefault(lab.get("tenant", "default"),
                            {})[phase] = summary
+    # delta-aware evaluation counters (expr/incremental.py): the
+    # engine notes per-dispatch events above ("incremental" kind) and
+    # this running summary makes the hit/fallback balance readable
+    # from one flightrec call without scanning the window
+    ctr = REGISTRY.counter_values()
+    incremental = {k: v for k, v in ctr.items()
+                   if k.startswith("incremental_")}
+    gauges = REGISTRY.snapshot()["gauges"]
+    cache_g = gauges.get("incremental_cache_bytes")
+    if cache_g is not None:
+        incremental["incremental_cache_bytes"] = cache_g["value"]
     return {"events": out_events, "requests": requests,
-            "tenants": tenants}
+            "tenants": tenants, "incremental": incremental}
 
 
 def clear() -> None:

@@ -617,6 +617,7 @@ def maybe_degrade(expr: Any, plan: Any, plan_key: Any,
         return NOT_HANDLED
     need = mem["peak_bytes_per_chip"] - donation_credit(
         mem, donated, mesh)
+    need += resident_cache_bytes_per_chip(mesh)
     if need <= budget:
         return NOT_HANDLED
     rung, rung_peak = choose_rung(expr, mesh, budget)
@@ -677,6 +678,27 @@ def redirect_governed(expr: Any, plan: Any, donated: List[Any],
     expr._result = result
     expr._resilience = rec
     return result
+
+
+def resident_cache_bytes_per_chip(mesh) -> int:
+    """Per-chip HBM pinned by the incremental engine's result cache
+    (expr/incremental.py, FLAGS.result_cache_bytes): cached results
+    hold live device buffers a new dispatch cannot reuse, so the
+    governor charges them against the budget like any other resident
+    set. Results are sharded, so the per-chip share is the cache total
+    over the device count. Zero when the cache is empty/off."""
+    from ..expr import incremental as inc_mod
+
+    total = inc_mod.cache_bytes()
+    if not total:
+        return 0
+    try:
+        ndev = 1
+        for v in dict(mesh.shape).values():
+            ndev *= int(v)
+    except Exception:
+        ndev = 1
+    return int(total / max(1, ndev))
 
 
 # -- serve admission (consumer 3) ----------------------------------------

@@ -474,3 +474,34 @@ def test_persist_seam_allowed_in_persist_layer():
     # ordinary attributes named like the API elsewhere are fine
     other = ast.parse("x = obj.serialize\nname = 'persist_cache_dir'\n")
     assert lint_repo.lint_persist_seam("/x/y.py", other) == []
+
+
+def test_catches_buffer_mutation_outside_seam(tmp_path):
+    bad = tmp_path / "bad_mutation.py"
+    bad.write_text(
+        "arr._jax = new_buf\n"
+        "arr._lineage = None\n"
+        "arr._version += 1\n"
+        "a._version, b._version = 1, 2\n"
+        "del arr._lineage\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_buffer_mutation(str(bad), tree)
+    assert sum(f.rule == "buffer-mutation" for f in findings) >= 5
+    assert all("DistArray.update()" in f.message for f in findings)
+    # reads are fine — only stores detach the lineage log
+    ok = ast.parse("v = arr._version\nif arr._lineage is None:\n"
+                   "    pass\n")
+    assert lint_repo.lint_buffer_mutation("/x/y.py", ok) == []
+
+
+def test_buffer_mutation_allowed_in_array_and_seam():
+    tree = ast.parse("self._jax = out\nself._lineage = lin\n"
+                     "child._version = lin.note(region)\n")
+    for rel in (os.path.join("spartan_tpu", "array", "distarray.py"),
+                os.path.join("spartan_tpu", "expr", "incremental.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_buffer_mutation(path, tree) == []
+    # same stores anywhere else are findings
+    other = os.path.join(lint_repo.REPO, "spartan_tpu", "serve",
+                         "engine.py")
+    assert lint_repo.lint_buffer_mutation(other, tree) != []

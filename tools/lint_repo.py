@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Thirteen repo-specific rules that generic linters cannot know:
+Fourteen repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -134,6 +134,16 @@ Thirteen repo-specific rules that generic linters cannot know:
     singleton's failure handling. Go through ``spartan_tpu.persist``
     (``active()`` / ``lookup()`` / ``maybe_store()`` / ``prewarm()``).
 
+14. No stores to a DistArray's private buffer/lineage state
+    (``._jax`` / ``._lineage`` / ``._version``) outside
+    ``spartan_tpu/array/`` and the incremental seam
+    (``spartan_tpu/expr/incremental.py``) — the delta-aware PR: the
+    incremental result cache trusts the Lineage mutation log as the
+    ONLY way data changes under a stable leaf identity
+    (docs/INCREMENTAL.md); a stray buffer poke makes a dirty tile
+    look clean and the cache serves stale results, bit-INequal to a
+    recompute. Mutate through ``DistArray.update()`` / ``st.assign``.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
 through the tier-1 suite (tests/test_lint_repo.py).
 """
@@ -181,7 +191,13 @@ _DISPATCH_CALLS = {"evaluate", "force", "recompute", "_dispatch", "jit"}
 # through a serve future (serve/engine workers) is a sanctioned
 # boundary shape — neither retries
 _ENGINE_ROUTES = {"handle_failure", "_handle_failure",
-                  "_reject", "set_exception"}
+                  "_reject", "set_exception",
+                  # the incremental engine's honest-fallback seam
+                  # (expr/incremental.py): the handler records the
+                  # reason and returns NOT_HANDLED so the ordinary
+                  # full dispatch runs — whose failures DO route
+                  # through the policy engine. It never retries.
+                  "degrade_to_full"}
 
 # rule 6: owners of the hot shared state; everyone else goes through
 # the accessors so locking/LRU/eviction stay in one place
@@ -257,6 +273,17 @@ _PERSIST_SERIALIZE_NAMES = {"serialize_executable",
 # ops/kmeans.py and ops/segment.py kernels were.
 _PALLAS_ALLOWED_DIRS = (os.path.join("spartan_tpu", "kernels")
                         + os.sep,)
+
+# rule 14: a DistArray's buffer/lineage state (_jax, _lineage,
+# _version) is the incremental engine's ground truth — a write from
+# anywhere but the array layer or the incremental seam silently
+# detaches the mutation log from the data, and the result cache then
+# serves stale tiles as "clean" (docs/INCREMENTAL.md).
+_MUTATION_ALLOWED_DIRS = (os.path.join("spartan_tpu", "array")
+                          + os.sep,)
+_MUTATION_ALLOWED_FILES = (
+    os.path.join("spartan_tpu", "expr", "incremental.py"),)
+_MUTATION_ATTRS = {"_jax", "_lineage", "_version"}
 
 
 class Finding:
@@ -792,6 +819,46 @@ def lint_persist_seam(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_buffer_mutation(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 14: no stores to a DistArray's private buffer/lineage
+    slots (``._jax`` / ``._lineage`` / ``._version``) outside
+    ``spartan_tpu/array/`` and the incremental seam
+    (``spartan_tpu/expr/incremental.py``) — every mutation must go
+    through ``DistArray.update()`` / ``st.assign`` so the Lineage log
+    stays truthful and the incremental result cache can never serve a
+    silently-mutated buffer as clean (docs/INCREMENTAL.md)."""
+    rel = os.path.relpath(path, REPO)
+    if (any(rel.startswith(d) for d in _MUTATION_ALLOWED_DIRS)
+            or rel in _MUTATION_ALLOWED_FILES):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, attr: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "buffer-mutation",
+            f"store to DistArray private state '.{attr}' outside the "
+            "array layer / incremental seam: mutate through "
+            "DistArray.update() or st.assign so the lineage log "
+            "(docs/INCREMENTAL.md) records the delta"))
+
+    def targets(node: ast.AST) -> List[ast.expr]:
+        if isinstance(node, ast.Assign):
+            return list(node.targets)
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            return [node.target]
+        if isinstance(node, ast.Delete):
+            return list(node.targets)
+        return []
+
+    for node in ast.walk(tree):
+        for t in targets(node):
+            for sub in ast.walk(t):
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr in _MUTATION_ATTRS):
+                    flag(node, sub.attr)
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -884,6 +951,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_sharding_constraints(path, tree))
         findings.extend(lint_pallas_imports(path, tree))
         findings.extend(lint_persist_seam(path, tree))
+        findings.extend(lint_buffer_mutation(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
