@@ -397,12 +397,26 @@ class DistArray:
         """Thread this array's lineage through a functionally-updated
         child: ``region`` (or whole-array when ``None``) becomes the
         delta between ``self``'s version and ``child``'s, with the
-        post-write region ``value`` stashed when available."""
+        post-write region ``value`` stashed when available.
+
+        A Lineage log is LINEAR, but ``update()`` is functional and may
+        branch: two children minted from the same parent diverge, and
+        if both shared one log the incremental engine would read a
+        sibling's writes as part of the other child's delta — and miss
+        that the child LACKS them — splicing a stale result. So a child
+        cut from a handle that is not the lineage tip gets a FRESH
+        Lineage (new identity): the engine's same-lineage check fails,
+        it performs one honest full recompute, and the new lineage
+        serves the branch's own deltas from then on."""
         lin = self._lineage
         if lin is None:
             lin = Lineage()
             lin.latest = self._version
             self._lineage = lin
+        elif self._version != lin.latest:
+            # branch point: ``self`` is an interior handle
+            lin = Lineage()
+            lin.latest = self._version
         child._lineage = lin
         child._version = lin.note(region, value)
 

@@ -88,10 +88,13 @@ _INC_FLAG = FLAGS.define_bool(
 _CACHE_FLAG = FLAGS.define_int(
     "result_cache_bytes", 256 << 20,
     "Budget for the incremental engine's per-plan result cache "
-    "(bounded LRU, host-held references to device buffers). Visible "
-    "to the memory governor's ledger via the incremental_cache_bytes "
-    "gauge / expr.incremental.cache_bytes(). A single result larger "
-    "than the budget is never cached.")
+    "(bounded LRU, host-held references to device buffers). Each "
+    "entry is charged for its cached result PLUS the leaf snapshots "
+    "it pins; residency (incl. the mutation-seam stash kept alive "
+    "by cached snapshots) is visible to the memory governor's ledger "
+    "via the incremental_cache_bytes gauge / "
+    "expr.incremental.cache_bytes(). A single entry larger than the "
+    "budget is never cached.")
 _FRAC_FLAG = FLAGS.define_float(
     "incremental_max_dirty_frac", 0.25,
     "Dirty-fraction ceiling for the incremental path: when the root's "
@@ -122,6 +125,18 @@ class Unsupported(Exception):
 
 
 class _Entry:
+    """One cached result. IMMUTABLE after construction: a warm splice
+    publishes a fresh entry with a compare-and-swap on the entry object
+    (see ``intercept``), so concurrent intercepts for the same plan key
+    can never observe — or double-account — a half-updated entry.
+
+    ``nbytes`` is the device residency attributable to the entry: the
+    cached result PLUS the leaf snapshots ``slots`` keeps alive (each
+    is a strong reference pinning that leaf's buffers), so the LRU
+    budget and the memory governor see what the entry actually pins.
+    Lineage stash bytes are shared across entries and accounted
+    separately in :func:`cache_bytes`."""
+
     __slots__ = ("result", "slots", "epoch", "nbytes")
 
     def __init__(self, result: Any, slots: Tuple, epoch: int,
@@ -139,9 +154,23 @@ _tls = threading.local()  # re-entry guard for the inner evaluates
 
 
 def cache_bytes() -> int:
-    """Current result-cache residency (device-buffer bytes pinned by
-    cached results) — the number the memory governor's ledger sees."""
-    return _total_bytes
+    """Current result-cache residency — the number the memory
+    governor's ledger sees: cached results, the leaf snapshots the
+    entries pin, and the mutation-seam stash of every Lineage a cached
+    snapshot keeps alive (deduplicated — lineages are shared across
+    handles and entries)."""
+    with _lock:
+        total = _total_bytes
+        seen: set = set()
+        for e in _cache.values():
+            for s in e.slots:
+                if s[0] != "a":
+                    continue
+                lin = s[1]._lineage
+                if lin is not None and id(lin) not in seen:
+                    seen.add(id(lin))
+                    total += lin.stash_bytes
+    return total
 
 
 def cache_entries() -> int:
@@ -189,7 +218,21 @@ def _drop(key: Tuple) -> None:
 def _gauge() -> None:
     REGISTRY.gauge(
         "incremental_cache_bytes",
-        "incremental result-cache residency, bytes").set(_total_bytes)
+        "incremental result-cache residency, bytes").set(cache_bytes())
+
+
+def _slots_nbytes(slots: Tuple) -> int:
+    """Device bytes pinned by an entry's leaf snapshots (deduplicated:
+    the same DistArray may fill several arg slots)."""
+    seen: set = set()
+    total = 0
+    for s in slots:
+        if s[0] != "a" or id(s[1]) in seen:
+            continue
+        seen.add(id(s[1]))
+        arr = s[1]
+        total += int(arr.size) * arr.dtype.itemsize
+    return total
 
 
 def _snapshot_slots(ordered: List[Any]) -> Optional[Tuple]:
@@ -230,7 +273,9 @@ def note_result(plan: Any, leaves: List[Any], order: Tuple[int, ...],
     slots = _snapshot_slots(ordered)
     if slots is None:
         return
-    nbytes = int(result.size) * result.dtype.itemsize
+    # charge the whole entry: result + the leaf snapshots it pins
+    nbytes = (int(result.size) * result.dtype.itemsize
+              + _slots_nbytes(slots))
     budget = _CACHE_FLAG._value
     if nbytes > budget:
         return
@@ -273,6 +318,10 @@ def _leaf_dirt(leaf: Any, slot: Tuple) -> Tuple[Any, Any]:
     if (lin is None or rec_arr._lineage is not lin
             or arr._version <= rec_ver):
         return FULL, None  # new identity / rewound handle: no delta
+    # same lineage at a higher version IS the ancestor chain:
+    # _record_mutation gives a branching update (child cut from a
+    # non-tip handle) a fresh Lineage, so each log stays linear and
+    # dirty_between() is exactly the delta between the two handles
     box = lin.dirty_between(rec_ver, arr._version, arr.shape)
     if box is None:
         return FULL, None
@@ -660,7 +709,7 @@ def _tile_counts(n: Any, r: Any, mesh: Any) -> Tuple[int, int]:
 
 def _report(plan: Any, **fields: Any) -> None:
     if plan is not None and plan.report is not None:
-        inc = {"cache_bytes": _total_bytes, "entries": len(_cache)}
+        inc = {"cache_bytes": cache_bytes(), "entries": len(_cache)}
         inc.update(fields)
         plan.report["incremental"] = inc
 
@@ -719,34 +768,38 @@ def intercept(expr: Any, plan: Any, leaves: List[Any],
             return degrade_to_full(plan, "donation")
 
     with prof.phase("incremental"):
-        dirt: Dict[int, Any] = {}
-        stashes: Dict[int, Tuple] = {}
-        for leaf, slot in zip(ordered, entry.slots):
-            d, sv = _leaf_dirt(leaf, slot)
-            if d is not None:
-                dirt[leaf._id] = d
-                if sv is not None:
-                    stashes[leaf._id] = sv
-        if not dirt:
-            # every leaf byte-identical to the cached evaluation: the
-            # cached result IS the answer — zero dispatches
-            prof.count("incremental_hits")
-            _report(plan, mode="cache-hit", fallback=None)
-            return entry.result
+        try:
+            dirt: Dict[int, Any] = {}
+            stashes: Dict[int, Tuple] = {}
+            for leaf, slot in zip(ordered, entry.slots):
+                d, sv = _leaf_dirt(leaf, slot)
+                if d is not None:
+                    dirt[leaf._id] = d
+                    if sv is not None:
+                        stashes[leaf._id] = sv
+            if not dirt:
+                # every leaf byte-identical to the cached evaluation:
+                # the cached result IS the answer — zero dispatches
+                prof.count("incremental_hits")
+                _report(plan, mode="cache-hit", fallback=None)
+                return entry.result
 
-        details: List[Tuple[Any, Any]] = []
-        root_dirt = _propagate(expr, dirt, {}, details)
-        if root_dirt is None:
-            prof.count("incremental_hits")
-            _report(plan, mode="cache-hit", fallback=None)
-            return entry.result
-        if root_dirt is FULL:
-            return degrade_to_full(plan, "dirty-full")
-        frac = root_dirt.size / max(1, expr.size)
-        if frac > _FRAC_FLAG._value:
-            return degrade_to_full(plan, f"dirty-frac:{frac:.3f}")
-
-        use_box = _quantize(root_dirt, expr.shape)
+            details: List[Tuple[Any, Any]] = []
+            root_dirt = _propagate(expr, dirt, {}, details)
+            if root_dirt is None:
+                prof.count("incremental_hits")
+                _report(plan, mode="cache-hit", fallback=None)
+                return entry.result
+            if root_dirt is FULL:
+                return degrade_to_full(plan, "dirty-full")
+            frac = root_dirt.size / max(1, expr.size)
+            if frac > _FRAC_FLAG._value:
+                return degrade_to_full(plan, f"dirty-frac:{frac:.3f}")
+            use_box = _quantize(root_dirt, expr.shape)
+        except Exception as e:  # noqa: BLE001 - honest-fallback: dirt
+            # computation/propagation errors degrade exactly like
+            # dispatch errors instead of failing the whole evaluate()
+            return degrade_to_full(plan, f"error:{type(e).__name__}")
         try:
             _tls.active = True
             sub_expr = None
@@ -788,15 +841,29 @@ def intercept(expr: Any, plan: Any, leaves: List[Any],
             _tls.active = False
 
         slots = _snapshot_slots(ordered)
-        nbytes = int(combined.size) * combined.dtype.itemsize
         if slots is not None:
+            nbytes = (int(combined.size) * combined.dtype.itemsize
+                      + _slots_nbytes(slots))
+            budget = _CACHE_FLAG._value
+            fresh = _Entry(combined, slots, entry.epoch, nbytes)
+            evicted = 0
             with _lock:
-                live = _cache.get(plan.key)
-                if live is entry:
-                    _total_bytes += nbytes - entry.nbytes
-                    entry.result = combined
-                    entry.slots = slots
-                    entry.nbytes = nbytes
+                # CAS on the entry object: publish only if the slot
+                # still holds the entry this splice was derived from. A
+                # racing intercept that loses the race keeps (and
+                # returns) its own correct result but doesn't publish,
+                # so the cache never mixes two splices' deltas and
+                # _total_bytes swaps exactly one entry's accounting.
+                if nbytes <= budget and _cache.get(plan.key) is entry:
+                    _cache[plan.key] = fresh
+                    _cache.move_to_end(plan.key)
+                    _total_bytes += fresh.nbytes - entry.nbytes
+                    while _total_bytes > budget and len(_cache) > 1:
+                        _, e = _cache.popitem(last=False)
+                        _total_bytes -= e.nbytes
+                        evicted += 1
+            if evicted:
+                prof.count("incremental_evictions", evicted)
         root_total, root_dirty = _tile_counts(expr, use_box, mesh)
         prof.count("incremental_hits")
         prof.count("incremental_recomputed_tiles", root_dirty)

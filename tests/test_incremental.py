@@ -648,3 +648,88 @@ def test_stash_respects_byte_cap(monkeypatch):
     # the oversized write is still lineage-logged (correctness intact)
     box = b._lineage.dirty_between(a._version, b._version, a.shape)
     assert (tuple(box.ul), tuple(box.lr)) == ((0, 0), (16, 4))
+
+
+# -- lineage branching (update() is functional: histories may fork) ------
+
+
+def test_branching_update_gets_fresh_lineage():
+    base = _arr(_rand((16, 16), seed=36))
+    a = base.update((slice(0, 2), slice(0, 16)), 5.0)
+    assert a._lineage is base._lineage
+    # a second child cut from the same (now non-tip) parent forks the
+    # history: it must NOT share the sibling's log
+    b = base.update((slice(4, 6), slice(0, 16)), 7.0)
+    assert b._lineage is not a._lineage
+    assert base._lineage is a._lineage  # the parent keeps its original
+    # the branch's own chain is linear again from here on
+    c = b.update((slice(8, 10), slice(0, 16)), 9.0)
+    assert c._lineage is b._lineage
+    box = c._lineage.dirty_between(b._version, c._version, b.shape)
+    assert (tuple(box.ul), tuple(box.lr)) == ((8, 0), (10, 16))
+
+
+def test_branching_update_is_not_served_a_sibling_delta():
+    """a = base.update(r1) warms the cache; b = base.update(r2) shares
+    base but LACKS a's write. Treating the lineage as one linear chain
+    would splice only r2 over a's cached result and serve a's stale r1
+    rows — b must be bit-equal to a full recompute."""
+    a_np = _rand((32, 32), seed=37)
+    base = _arr(a_np)
+
+    def build(arr):
+        return lazify(arr) * 2.0 + 1.0
+
+    evaluate(build(base))  # seed the cache at base
+    a = base.update((slice(0, 2), slice(0, 32)), 5.0)
+    evaluate(build(a))  # the entry now snapshots a (r1 spliced in)
+    b = base.update((slice(4, 6), slice(0, 32)), 7.0)  # the branch
+    r = evaluate(build(b))
+    b_np = a_np.copy()
+    b_np[4:6] = 7.0
+    assert np.array_equal(r.glom(), _full_reference(build, b_np))
+
+
+# -- residency accounting (entry pins leaves; lineage pins stash) --------
+
+
+def test_cache_accounting_includes_leaf_snapshots_and_stash():
+    inc.clear()
+    one = int(np.prod((32, 32))) * 4  # one f32 buffer
+    a_np = _rand((32, 32), seed=38)
+    a = _arr(a_np)
+    evaluate(lazify(a) + 1.0)
+    # the entry pins the result AND the leaf snapshot: both charged
+    assert inc.cache_bytes() >= 2 * one
+    a2 = a.update((slice(0, 2), slice(0, 32)), 3.0)
+    evaluate(lazify(a2) + 1.0)  # warm splice re-snapshots a2
+    lin = a2._lineage
+    assert lin is not None and lin.stash_bytes > 0
+    # the mutation-seam stash the cached snapshot keeps alive is
+    # governor-visible too
+    assert inc.cache_bytes() >= 2 * one + lin.stash_bytes
+
+
+# -- dirt-phase failures honor the honest-fallback contract --------------
+
+
+def test_dirt_phase_error_degrades_to_full(monkeypatch):
+    a_np = _rand((32, 32), seed=39)
+    a = _arr(a_np)
+
+    def build(arr):
+        return lazify(arr) * 3.0
+
+    evaluate(build(a))  # seed the warm path
+    a2 = a.update((slice(0, 2), slice(0, 32)), 1.0)
+
+    def boom(*_a, **_k):
+        raise ValueError("malformed node")
+
+    monkeypatch.setattr(inc, "_propagate", boom)
+    f0 = _counter("incremental_fallbacks")
+    r = evaluate(build(a2))  # propagation blows up -> full dispatch
+    assert _counter("incremental_fallbacks") == f0 + 1
+    a2_np = a_np.copy()
+    a2_np[0:2] = 1.0
+    assert np.array_equal(r.glom(), _full_reference(build, a2_np))
