@@ -344,6 +344,20 @@ def incremental_overhead(st):
     return inc_bench.measure()
 
 
+def plan_audit_overhead(st):
+    """Plan-auditor gates (benchmarks/plan_audit.py): golden audits of
+    four canonical plans (dot / stencil halo / sample sort /
+    incremental splice) flattened into exact collective-count and
+    byte-total gates — the CI tripwire for communication regressions —
+    plus the auditor's hit-path toll (<=1% is the ISSUE-17 gate: the
+    audit is wired into the compile-miss path only, so verify-on and
+    verify-off hit iterations run identical code)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import plan_audit as pa
+
+    return pa.measure(iters=30, n=256 if SMALL else 512)
+
+
 def serving_overhead(st):
     """Serving-engine gates (benchmarks/serving_latency.py): 16-client
     coalesced throughput vs a serial evaluate() loop (>=3x is the
@@ -451,6 +465,20 @@ def guard_metrics(report) -> dict:
         "incremental_warm_speedup_1pct":
             report["incremental_overhead"].get(
                 "incremental_warm_speedup_1pct"),
+        "audit_off_overhead_ratio":
+            report["plan_audit_overhead"].get(
+                "audit_off_overhead_ratio"),
+        # golden plan audits (benchmarks/plan_audit.py): exact
+        # collective counts + byte ceilings per canonical plan
+        **{k: report["plan_audit_overhead"].get(k)
+           for k in ("audit_dot_all_reduce", "audit_dot_all_gather",
+                     "audit_dot_comm_kib", "audit_stencil_permute",
+                     "audit_stencil_all_gather",
+                     "audit_stencil_comm_kib",
+                     "audit_sort_all_to_all", "audit_sort_all_reduce",
+                     "audit_sort_comm_kib",
+                     "audit_splice_full_gather_findings",
+                     "audit_splice_comm_kib")},
         # per-op pallas-vs-gspmd floors: judged on TPU only (the CPU
         # native arm is interpret-mode parity evidence — no cpu
         # thresholds are committed for these)
@@ -502,6 +530,7 @@ def main():
         "warmstart_overhead": _with_metrics(warmstart_overhead, st),
         "incremental_overhead": _with_metrics(incremental_overhead,
                                               st),
+        "plan_audit_overhead": _with_metrics(plan_audit_overhead, st),
     }
     # full flag state once at report level (the per-record
     # flags_nondefault deltas are diffs against these defaults)
@@ -542,7 +571,22 @@ def main():
                  "profile_off_overhead_ratio": 0.01,
                  "kernels_off_overhead_ratio": 0.01,
                  "warmstart_off_overhead_ratio": 0.01,
-                 "incremental_off_overhead_ratio": 0.01}
+                 "incremental_off_overhead_ratio": 0.01,
+                 "audit_off_overhead_ratio": 0.01}
+        # golden-audit gates: collective COUNTS commit exact
+        # (min==max — a regression in either direction is a lowering
+        # change worth a look), modeled byte totals commit a 1.25x
+        # ceiling (benchmarks/plan_audit.py)
+        audit_exact = {"audit_dot_all_reduce", "audit_dot_all_gather",
+                       "audit_stencil_permute",
+                       "audit_stencil_all_gather",
+                       "audit_sort_all_to_all",
+                       "audit_sort_all_reduce",
+                       "audit_splice_full_gather_findings"}
+        audit_ceiling = {"audit_dot_comm_kib",
+                         "audit_stencil_comm_kib",
+                         "audit_sort_comm_kib",
+                         "audit_splice_comm_kib"}
         # fixed FLOORS (ISSUE gates on ratios that must stay high):
         # coalescing must amortize dispatch >=3x across 16 clients;
         # a Pallas kernel keeps its slot only while it beats (kmeans)
@@ -571,6 +615,10 @@ def main():
                 entry[k] = {"min": fixed_min[k]}
             elif k in fixed:
                 entry[k] = {"max": fixed[k]}
+            elif k in audit_exact:
+                entry[k] = {"min": v, "max": v}
+            elif k in audit_ceiling:
+                entry[k] = {"max": round(v * 1.25, 1)}
             elif k.endswith("seconds"):
                 entry[k] = {"max": round(v / 0.7, 4)}
             else:

@@ -30,7 +30,7 @@ from .parallel.mesh import (StaleMeshError, build_mesh, get_mesh,
                             initialize_distributed, mesh_epoch,
                             rebuild_mesh, set_mesh, status, use_mesh)
 from .ops.stencil import avgpool, maxpool, stencil
-from .analysis import check, lint
+from .analysis import PlanAudit, audit_plan, check, lint
 from . import obs
 from .obs import (AuditReport, CalibrationProfile, DeviceProfile,
                   ExplainReport, Watchpoint, audit, explain,
@@ -54,7 +54,7 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "initialize_distributed", "shutdown", "status", "collectives",
             "rebuild_mesh", "mesh_epoch", "StaleMeshError",
             "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
-            "check", "lint",
+            "check", "lint", "audit_plan", "PlanAudit",
             "obs", "persist", "explain", "ExplainReport", "metrics", "trace_export",
             "trace_events", "trace_clear",
             "ledger", "flightrec", "CalibrationProfile", "fit_profile",

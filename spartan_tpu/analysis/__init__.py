@@ -1,7 +1,7 @@
 """Static analysis over the lazy expression DAG — the checking layer
 for the optimizer pipeline (ISSUE 2: graph sanitizer).
 
-Three coordinated tools, none of which compile or execute anything:
+Four coordinated tools, none of which execute anything:
 
 * :mod:`verify` — the DAG well-formedness verifier. One traversal
   re-derives every node's shape/dtype from its children (via the
@@ -18,24 +18,36 @@ Three coordinated tools, none of which compile or execute anything:
   declared-tiling vs sort-kernel ``out_specs`` cross-checks (the
   ADVICE r5 #1 bug class), and unresolvable/degenerate tiling
   warnings.
+* :mod:`plan_audit` (+ :mod:`hlo`) — the static communication audit
+  one layer further down: AOT-lower + compile a plan (no dispatch)
+  and walk the post-GSPMD module for every collective with modeled
+  wire bytes, full-operand-gather / replicated-intermediate /
+  missed-donation findings, each attributed back to its expr node
+  via the digest-carrying named scopes (docs/ANALYSIS.md).
 
-Public surface (re-exported as ``st.check`` / ``st.lint``):
+Public surface (re-exported as ``st.check`` / ``st.lint`` /
+``st.audit_plan``):
 
 * ``check(expr, donate=())`` — raise :class:`VerificationError` on
   any violation or error-severity lint; returns the warning-level
   findings otherwise.
 * ``lint(expr, donate=())`` — return ALL findings without raising.
+* ``audit_plan(expr, donate=())`` — return the :class:`PlanAudit`
+  of the plan this expression would evaluate with (compiles, never
+  dispatches; findings are advisory).
 """
 
 from .verify import (VerificationError, Violation, verify_dag, walk)
 from .lints import LintFinding, LintWarning, lint
 from .passes import PassInvariantError
+from .plan_audit import AuditFinding, PlanAudit, audit_plan
 
 from typing import Any, List, Sequence
 
 __all__ = ["check", "lint", "verify_dag", "walk", "Violation",
            "LintFinding", "LintWarning", "VerificationError",
-           "PassInvariantError"]
+           "PassInvariantError", "PlanAudit", "AuditFinding",
+           "audit_plan"]
 
 
 def check(expr: Any, donate: Sequence[Any] = ()) -> List[LintFinding]:

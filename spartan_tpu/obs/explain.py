@@ -283,7 +283,41 @@ def build_plan_report(expr: Any, dag: Any, leaves: Sequence[Any],
         "arg_specs": _arg_specs(leaves),
         "cost_analysis": None,
     }
+    # filled in by _build_plan's epilogue (scope_digest_table): the
+    # digest must be computed from FINAL node state, after every
+    # build-time walk that can stamp tiling decisions onto nodes
+    report["scope_digests"] = {}
     return report
+
+
+def scope_digest_table(dag: Any) -> Dict[str, Dict[str, Any]]:
+    """digest -> node table for the plan auditor: the SAME ``__sg_``
+    scope digests a naming session (obs/profile.py) stamps into this
+    plan's lowered HLO, mapped back to node label + user build site.
+    Called at the very END of ``_build_plan`` (miss path, one extra
+    signing traversal) because (a) the optimized DAG is unreachable
+    once the plan is cached and (b) the build's later walks mutate
+    node tiling state, which is part of the signature the trace-time
+    naming session will hash."""
+    try:
+        from ..expr.optimize import dag_nodes
+        from .profile import _NamingCtx
+
+        nctx = _NamingCtx()
+        # memoize ROOT-FIRST, exactly like the trace-time session: a
+        # signing context writes ("ref", i) placeholders for already-
+        # visited subtrees, so leaf-first memoization would hash
+        # DIFFERENT parent signatures than the scopes in the HLO carry
+        nctx.digest(dag)
+        digests: Dict[str, Dict[str, Any]] = {}
+        for n in dag_nodes(dag):
+            dg = nctx.digest(n)
+            if dg:
+                digests[dg] = {"node": _label(n),
+                               "site": _site_str(n._site)}
+        return digests
+    except Exception:  # noqa: BLE001 - attribution is advisory
+        return {}
 
 
 def compiled_cost_analysis(compiled: Any) -> Dict[str, float]:
@@ -415,6 +449,17 @@ class ExplainReport:
                     line += (f" via {e['schedule']} [{e['path']}, "
                              f"cost~{e['modeled_cost']}]")
                 lines.append(line)
+        aud = d.get("audit")
+        if aud:
+            # static communication audit (analysis/plan_audit.py):
+            # the per-node collective table with modeled wire bytes,
+            # plus any findings (full_gather / replicated_intermediate
+            # / missed_donation) — docs/ANALYSIS.md explains how to
+            # read it
+            from ..analysis.plan_audit import PlanAudit
+
+            for ln in str(PlanAudit.from_dict(aud)).splitlines():
+                lines.append("  " + ln)
         if d.get("migrations"):
             # leaves that crossed a mesh-shape transition (elastic
             # rehome / checkpoint restore) through the migration

@@ -314,6 +314,13 @@ def maybe_store(plan: Any, executable: Any, mesh: Any) -> bool:
             "arg_order": list(arg_order),
             "nargs": len(arg_order),
         }
+        # the plan-audit verdict (analysis/plan_audit.py) rides the
+        # entry when one was computed: a warm restart restores it with
+        # the executable and never re-lowers for the audit. JSON-safe
+        # by construction (PlanAudit.to_dict).
+        verdict = (plan.report or {}).get("audit")
+        if verdict is not None:
+            plan_meta["audit"] = verdict
         landed = store.save(digest, env_fingerprint(mesh), plan_meta,
                             payload, (in_tree, out_tree))
     except Exception as e:  # noqa: BLE001 - persistence is best-effort

@@ -84,7 +84,7 @@ class Entry:
     pre-seeding the compile cache."""
 
     __slots__ = ("digest", "compiled", "out_tilings_json", "is_tuple",
-                 "arg_order", "nargs")
+                 "arg_order", "nargs", "audit")
 
     def __init__(self, digest: str, compiled: Any, plan_meta: Dict[str, Any]):
         self.digest = digest
@@ -94,6 +94,9 @@ class Entry:
         ao = plan_meta["arg_order"]
         self.arg_order = tuple(int(i) for i in ao) if ao is not None else None
         self.nargs = int(plan_meta["nargs"])
+        # plan-audit verdict persisted alongside the executable
+        # (analysis/plan_audit.py) — None for pre-audit entries
+        self.audit = plan_meta.get("audit")
 
     def matches(self, out_tilings, is_tuple: bool,
                 arg_order: Optional[Tuple[int, ...]], nargs: int) -> bool:

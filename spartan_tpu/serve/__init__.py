@@ -48,13 +48,13 @@ contract and benchmarks/serving_latency.py for the acceptance gates.
 from .coalesce import reset_modes
 from .engine import (ServeEngine, default_engine, evaluate_async,
                      peek_default, shutdown_default)
-from .future import (Backpressure, DeadlineExceeded, EvalFuture,
-                     MeshReconfiguring, ServeError)
+from .future import (Backpressure, CommBudgetExceeded, DeadlineExceeded,
+                     EvalFuture, MeshReconfiguring, ServeError)
 from .queue import AdmissionQueue
 
 __all__ = [
     "ServeEngine", "AdmissionQueue", "EvalFuture", "ServeError",
-    "Backpressure", "DeadlineExceeded", "MeshReconfiguring",
-    "evaluate_async", "default_engine", "peek_default",
-    "shutdown_default", "reset_modes",
+    "Backpressure", "CommBudgetExceeded", "DeadlineExceeded",
+    "MeshReconfiguring", "evaluate_async", "default_engine",
+    "peek_default", "shutdown_default", "reset_modes",
 ]

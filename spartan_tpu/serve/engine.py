@@ -60,8 +60,8 @@ from ..utils.config import FLAGS
 from ..utils.log import log_warn
 from ..resilience import classify as resilience_classify
 from . import coalesce
-from .future import (Backpressure, DeadlineExceeded, EvalFuture,
-                     MeshReconfiguring)
+from .future import (Backpressure, CommBudgetExceeded, DeadlineExceeded,
+                     EvalFuture, MeshReconfiguring)
 from .queue import AdmissionQueue
 
 FLAGS.define_int(
@@ -88,6 +88,14 @@ FLAGS.define_bool(
     "Coalesce identical-signature requests into leading-axis batched "
     "dispatches (one compile, one dispatch, N responses). Off = every "
     "request dispatches solo (still async, still admission-controlled).")
+_COMM_BUDGET_FLAG = FLAGS.define_int(
+    "comm_budget_bytes", 0,
+    "Communication-aware admission: when > 0, a submission whose plan "
+    "carries an audit verdict (analysis/plan_audit.py — the compile "
+    "miss ran under FLAGS.verify_evaluate, or st.audit_plan was "
+    "called) with modeled per-chip wire bytes above this budget is "
+    "rejected with CommBudgetExceeded and the finding in its flight "
+    "record. 0 = off (one flag read per submit).")
 
 
 def _pow2_chunks(batch: List["_Request"]) -> List[List["_Request"]]:
@@ -375,6 +383,38 @@ class ServeEngine:
                 raise Backpressure(
                     self.queue.depth(),
                     self.queue.retry_after_s(self.workers))
+        # communication-aware admission (docs/ANALYSIS.md): a plan
+        # whose AUDITED wire total exceeds the budget is rejected
+        # before it queues — non-retryable (the same expr meets the
+        # same plan), with the worst finding in the flight record.
+        # Unaudited plans pass: the budget gates verdicts, it does not
+        # force an AOT compile onto the submit path.
+        comm_budget = _COMM_BUDGET_FLAG._value
+        if comm_budget:
+            plan = base.lookup_plan(req.plan_key)
+            verdict = (plan.report.get("audit")
+                       if plan is not None and plan.report is not None
+                       else None)
+            if verdict and verdict.get("comm_bytes", 0.0) > comm_budget:
+                if _METRICS_FLAG._value:
+                    REGISTRY.counter(
+                        "serve_comm_rejected",
+                        "submissions rejected because their plan's "
+                        "audited communication exceeds "
+                        "FLAGS.comm_budget_bytes").inc()
+                worst = max(
+                    verdict.get("collectives") or [{}],
+                    key=lambda c: c.get("bytes_moved", 0.0))
+                finding = (f"{worst.get('kind', '?')} on "
+                           f"{worst.get('node') or '<unattributed>'} "
+                           f"~{worst.get('bytes_moved', 0.0):.0f}B/chip")
+                flight_mod.note(
+                    req.rid, "reject", reason="comm_budget",
+                    comm_bytes=verdict.get("comm_bytes"),
+                    budget_bytes=comm_budget, finding=finding)
+                raise CommBudgetExceeded(
+                    float(verdict.get("comm_bytes", 0.0)), comm_budget,
+                    finding)
         if not self.running:
             self.start()
         try:

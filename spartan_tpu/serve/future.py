@@ -45,6 +45,24 @@ class DeadlineExceeded(ServeError):
     was shed from the queue) or before its result resolved."""
 
 
+class CommBudgetExceeded(ServeError):
+    """Admission control rejected the request because its plan's
+    audited communication total (analysis/plan_audit.py, cached on the
+    plan report) exceeds ``FLAGS.comm_budget_bytes``. NOT retryable —
+    resubmitting the same expression meets the same plan; restructure
+    the computation (or raise the budget). The finding lands in the
+    flight record (``st.flightrec``) with the modeled bytes."""
+
+    def __init__(self, comm_bytes: float, budget_bytes: int,
+                 detail: str = ""):
+        super().__init__(
+            f"plan's modeled communication ~{comm_bytes:.0f} bytes/chip "
+            f"exceeds FLAGS.comm_budget_bytes={budget_bytes}"
+            + (f" ({detail})" if detail else ""))
+        self.comm_bytes = comm_bytes
+        self.budget_bytes = budget_bytes
+
+
 class MeshReconfiguring(ServeError):
     """The mesh is being rebuilt after persistent device/host loss
     (elastic recovery): this request was drained, or arrived during

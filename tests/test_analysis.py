@@ -380,6 +380,41 @@ def test_lint_unresolvable_tiling_warning():
     assert any(f.kind == "unresolvable_tiling" for f in findings)
 
 
+def test_seeded_tiling_rank_corruption_caught_by_lint_layer():
+    """Mutation coverage for the LINT layer's rank check (kind
+    ``tiling_rank``), distinct from the verifier's
+    ``forced_tiling_rank``: seed a wrong-rank forced tiling and assert
+    the non-raising ``lint()`` entry point reports it as an error
+    attributed to the corrupted node — deleting the rank check in
+    ``tiling_findings`` fails THIS test even while ``st.check`` still
+    raises via the verifier."""
+    e = st.as_expr(_arr()) + 1.0
+    e._forced_tiling = tiling_mod.row(3)  # rank 3 on a rank-2 node
+    findings = lint(e)
+    hits = [f for f in findings if f.kind == "tiling_rank"]
+    assert hits and all(f.severity == "error" for f in hits)
+    assert any("rank 3" in f.message and "rank 2" in f.message
+               for f in hits)
+
+
+def test_seeded_use_after_donate_caught_by_lint_layer():
+    """Mutation coverage for ``donation_findings``: donate through a
+    REAL dispatch (not a hand-set flag), then reuse the dead handle —
+    the non-raising ``lint()`` entry point must surface the
+    ``use_after_donate`` error with the donating call's provenance,
+    independent of ``st.check``'s raise path."""
+    x = st.from_numpy(np.random.RandomState(11).rand(8, 8)
+                      .astype(np.float32)).evaluate()
+    stale = st.as_expr(x) * 3.0       # built BEFORE the donation
+    evaluate(st.as_expr(x) + 1.0, donate=[x])
+    assert x.is_donated
+    findings = lint(stale)
+    hits = [f for f in findings if f.kind == "use_after_donate"]
+    assert hits and all(f.severity == "error" for f in hits)
+    # provenance: the donating call's user site is in the message
+    assert any("test_analysis" in f.message for f in hits)
+
+
 # -- Expr.__bool__ satellite --------------------------------------------
 
 

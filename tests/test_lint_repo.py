@@ -505,3 +505,65 @@ def test_buffer_mutation_allowed_in_array_and_seam():
     other = os.path.join(lint_repo.REPO, "spartan_tpu", "serve",
                          "engine.py")
     assert lint_repo.lint_buffer_mutation(other, tree) != []
+
+
+def test_catches_dynamic_slice_outside_seam(tmp_path):
+    bad = tmp_path / "bad_slice.py"
+    bad.write_text(
+        "import jax.lax as lax\n"
+        "from jax.lax import dynamic_slice\n"
+        "def f(x, i):\n"
+        "    y = lax.dynamic_slice(x, (i, 0), (4, 4))\n"
+        "    return lax.dynamic_update_slice(x, y, (i, 0))\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_dynamic_slices(str(bad), tree)
+    assert sum(f.rule == "traced-start-slice" for f in findings) == 3
+    assert all("full_gather" in f.message for f in findings)
+    assert all("docs/INCREMENTAL.md" in f.message for f in findings)
+    # the static-bound forms are NOT the gather class and pass
+    ok = ast.parse("import jax.lax as lax\n"
+                   "a = lax.dynamic_slice_in_dim(x, 0, 4)\n"
+                   "b = lax.slice(x, (0,), (4,))\n")
+    assert lint_repo.lint_dynamic_slices("/x/y.py", ok) == []
+
+
+def test_dynamic_slice_allowed_in_incremental_seam():
+    tree = ast.parse("import jax.lax as lax\n"
+                     "y = lax.dynamic_slice(x, starts, sizes)\n"
+                     "z = lax.dynamic_update_slice(d, s, starts)\n")
+    seam = os.path.join(lint_repo.REPO, "spartan_tpu", "expr",
+                        "incremental.py")
+    assert lint_repo.lint_dynamic_slices(seam, tree) == []
+    other = os.path.join(lint_repo.REPO, "spartan_tpu", "ops",
+                         "stencil.py")
+    assert lint_repo.lint_dynamic_slices(other, tree) != []
+
+
+def test_json_output_schema(capsys):
+    import json
+
+    # clean repo: --json prints an empty array, exit code 0
+    assert lint_repo.main(["--json"]) == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+    # the serialization itself: every finding becomes a flat object
+    # with exactly the four keys CI tooling keys on
+    f = lint_repo.Finding(
+        os.path.join(lint_repo.REPO, "spartan_tpu", "x.py"),
+        7, "traced-start-slice", "msg")
+    row = {"path": f.path, "line": f.line, "rule": f.rule,
+           "message": f.message}
+    assert row == {"path": os.path.join("spartan_tpu", "x.py"),
+                   "line": 7, "rule": "traced-start-slice",
+                   "message": "msg"}
+
+
+def test_module_entry_point():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint_repo", "--json"],
+        cwd=lint_repo.REPO, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+    assert json.loads(proc.stdout) == []

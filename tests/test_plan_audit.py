@@ -1,0 +1,211 @@
+"""Plan auditor (ISSUE 17, analysis/plan_audit.py + analysis/hlo.py).
+
+The contract under test: ``st.audit_plan`` AOT-lowers a plan over its
+committed shardings — no execution — and reports every collective in
+the post-GSPMD module with modeled wire bytes, attributed back to the
+expr node whose ``__sg_<digest>`` scope emitted it. Golden audits pin
+the communication shape of three canonical plans (the CI tripwire the
+benchmark gates mirror); the pathological traced-start dynamic slice
+MUST surface the ``full_gather`` finding with node + source
+provenance; the donation header check catches silently-dropped
+donations; the verdict memoizes, rides the persist store across a
+warm restart, renders in ``st.explain``, and powers the serve
+engine's ``FLAGS.comm_budget_bytes`` admission gate.
+"""
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import tiling as tiling_mod
+from spartan_tpu.array.tiling import Tiling
+from spartan_tpu.expr import base as expr_base
+from spartan_tpu.expr import incremental
+from spartan_tpu.obs.metrics import REGISTRY
+from spartan_tpu.utils import profiling
+from spartan_tpu.utils.config import FLAGS
+
+
+def _counter(name):
+    return REGISTRY.counter_values().get(name, 0)
+
+
+def _arr(shape, tiling=None, seed=0):
+    rng = np.random.RandomState(seed)
+    return st.from_numpy(rng.rand(*shape).astype(np.float32),
+                         tiling=tiling)
+
+
+# -- golden audits (the benchmark gate's in-suite counterpart) -----------
+
+
+def test_audit_dot_sharded_contract(mesh1d):
+    """Row-sharded dot: the contraction all-reduces partial products
+    and must NOT gather an operand — the sharding is load-bearing."""
+    a = _arr((32, 32), tiling_mod.row(2), seed=1)
+    b = _arr((32, 32), tiling_mod.row(2), seed=2)
+    audit = st.audit_plan(st.dot(st.as_expr(a), st.as_expr(b)))
+    assert audit.multiset.get("all-reduce", 0) == 1
+    assert audit.multiset.get("all-gather", 0) == 0
+    assert audit.comm_bytes > 0
+    assert audit.findings == []
+    # attribution: the collectives join back to the dot node, not
+    # <unattributed>, through the __sg_ scope digests
+    nodes = [r["node"] for r in audit.per_node()]
+    assert any(n and "DotExpr" in n for n in nodes), nodes
+
+
+def test_audit_stencil_halo_permutes_only(mesh1d):
+    """H-sharded SAME-padding stencil: GSPMD lowers the halo exchange
+    to two collective-permutes (up + down) — any all-gather here means
+    the neighbor exchange degraded to full replication."""
+    x = _arr((1, 32, 16, 4), Tiling((None, "x", None, None)), seed=3)
+    k = np.random.RandomState(4).rand(3, 3, 4, 4).astype(np.float32)
+    audit = st.audit_plan(st.stencil(st.as_expr(x), k))
+    assert audit.multiset.get("collective-permute", 0) == 2
+    assert audit.multiset.get("all-gather", 0) == 0
+    assert audit.multiset.get("all-reduce", 0) == 0
+    assert not [f for f in audit.findings if f.kind == "full_gather"]
+    nodes = [r["node"] for r in audit.per_node()]
+    assert any(n and "StencilExpr" in n for n in nodes), nodes
+
+
+def test_audit_traced_start_slice_flags_full_gather(mesh2d):
+    """The pathological class the auditor exists for: a traced-start
+    dynamic slice of a sharded operand all-gathers the ENTIRE operand
+    onto every chip. The finding must name the node and the build
+    site in the incremental seam (the one sanctioned construction
+    site — lint rule 15 bans it everywhere else)."""
+    incremental._types()
+    xs = _arr((32, 16), tiling_mod.row(2), seed=5)
+    sl = incremental.DynSliceExpr(
+        st.as_expr(xs),
+        (expr_base.ScalarExpr(0), expr_base.ScalarExpr(0)), (4, 16))
+    audit = st.audit_plan(sl)
+    hits = [f for f in audit.findings if f.kind == "full_gather"]
+    assert hits, [str(f) for f in audit.findings]
+    f = hits[0]
+    assert f.node is not None          # attributed, not <unattributed>
+    assert f.source and "incremental.py" in f.source
+    assert f.bytes and f.bytes >= 32 * 16 * 4  # the WHOLE leaf, per chip
+    assert "docs/INCREMENTAL.md" in f.message
+
+
+# -- donation header check -----------------------------------------------
+
+
+def test_audit_donation_honored_and_missed(mesh2d):
+    # same-shape elementwise: the executable aliases the donated slot
+    y = _arr((8, 8), seed=6).evaluate()
+    ok = st.audit_plan(st.as_expr(y) * 2.0, donate=[y])
+    assert ok.donation["requested"] == [0]
+    assert 0 in ok.donation["aliased"]
+    assert not [f for f in ok.findings if f.kind == "missed_donation"]
+
+    # scalar-out reduction: nothing to alias an (8,8) buffer against —
+    # the input_output_alias header drops the request, and the audit
+    # says so instead of letting the runtime copy silently
+    z = _arr((8, 8), seed=7).evaluate()
+    missed = st.audit_plan((st.as_expr(z) + 1.0).sum(), donate=[z])
+    assert missed.donation["requested"] == [0]
+    assert 0 not in missed.donation["aliased"]
+    assert [f for f in missed.findings if f.kind == "missed_donation"]
+
+
+# -- verdict caching ------------------------------------------------------
+
+
+def test_audit_verdict_memoized(mesh2d):
+    a = _arr((16, 16), tiling_mod.row(2), seed=8)
+    e = st.dot(st.as_expr(a), st.as_expr(a)) + 5.0
+    runs0, cached0 = _counter("audit_runs"), _counter("audit_cached")
+    first = st.audit_plan(e)
+    second = st.audit_plan(e)
+    assert _counter("audit_runs") - runs0 == 1, \
+        "repeat audits must read the memoized verdict, not recompile"
+    assert _counter("audit_cached") - cached0 == 1
+    assert second.multiset == first.multiset
+    assert second.comm_bytes == first.comm_bytes
+
+
+def test_warm_restart_restores_verdict_no_reaudit(mesh2d, tmp_path):
+    """The verdict rides the persist store's plan metadata: a restart
+    restores audit + executable together, and the verify-on miss path
+    reads the restored verdict instead of re-lowering."""
+    from spartan_tpu import persist
+
+    FLAGS.persist_cache_dir = str(tmp_path / "store")
+    expr_base.clear_compile_cache()
+    persist.reset()
+    prev = FLAGS.verify_evaluate
+    FLAGS.verify_evaluate = True
+    try:
+        def build():
+            a = _arr((16, 16), tiling_mod.row(2), seed=9)
+            return st.dot(st.as_expr(a), st.as_expr(a)).sum()
+
+        runs0 = _counter("audit_runs")
+        build().evaluate()
+        assert _counter("audit_runs") - runs0 == 1  # cold: one audit
+
+        # simulated restart: in-memory caches dropped, disk survives
+        expr_base.clear_compile_cache()
+        persist.reset()
+        profiling.reset_counters()
+        runs1, cached1 = _counter("audit_runs"), _counter("audit_cached")
+        build().evaluate()
+        assert profiling.counters().get("compiles", 0) == 0
+        assert _counter("audit_runs") - runs1 == 0, \
+            "a persist-restored verdict must not re-audit"
+        assert _counter("audit_cached") - cached1 == 1
+    finally:
+        FLAGS.verify_evaluate = prev
+
+
+# -- surfaces -------------------------------------------------------------
+
+
+def test_explain_renders_collective_table(mesh2d):
+    a = _arr((16, 16), tiling_mod.row(2), seed=10)
+    e = st.dot(st.as_expr(a), st.as_expr(a))
+    st.audit_plan(e)
+    rep = str(st.explain(e))
+    assert "plan audit:" in rep
+    assert "DotExpr" in rep
+    assert "all-reduce" in rep
+
+
+def test_comm_budget_serve_admission(mesh1d):
+    """FLAGS.comm_budget_bytes gates AUDITED verdicts at submit time:
+    over-budget plans are rejected with the worst finding in the
+    flight record; unaudited plans pass (the budget never forces an
+    AOT compile onto the submit path)."""
+    from spartan_tpu.obs import flight
+    from spartan_tpu.serve import CommBudgetExceeded
+
+    a = _arr((32, 32), tiling_mod.row(2), seed=11)
+    b = _arr((32, 32), tiling_mod.row(2), seed=12)
+    e = st.dot(st.as_expr(a), st.as_expr(b)).sum()
+    audit = st.audit_plan(e)
+    assert audit.comm_bytes > 1
+
+    prev = FLAGS.comm_budget_bytes
+    try:
+        FLAGS.comm_budget_bytes = 1
+        with st.ServeEngine(workers=1) as eng:
+            with pytest.raises(CommBudgetExceeded) as ei:
+                eng.submit(e)
+            assert ei.value.comm_bytes == audit.comm_bytes
+            ev = [v for v in flight.events() if v.kind == "reject"
+                  and v.args.get("reason") == "comm_budget"]
+            assert ev and ev[-1].args.get("finding")
+
+            # an UNAUDITED plan sails through the same budget
+            fresh = (st.as_expr(a) + st.as_expr(b)).sum() * 99.0
+            assert float(eng.submit(fresh).glom(timeout=60)) != 0
+
+        FLAGS.comm_budget_bytes = int(audit.comm_bytes) + 1
+        with st.ServeEngine(workers=1) as eng:
+            assert np.isfinite(float(eng.submit(e).glom(timeout=60)))
+    finally:
+        FLAGS.comm_budget_bytes = prev

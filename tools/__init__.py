@@ -1,0 +1,1 @@
+"""Repo tooling: the custom AST lint (``python -m tools.lint_repo``)."""

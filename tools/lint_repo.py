@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Fourteen repo-specific rules that generic linters cannot know:
+Fifteen repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -144,8 +144,23 @@ Fourteen repo-specific rules that generic linters cannot know:
     look clean and the cache serves stale results, bit-INequal to a
     recompute. Mutate through ``DistArray.update()`` / ``st.assign``.
 
-Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings) or
-through the tier-1 suite (tests/test_lint_repo.py).
+15. No ``lax.dynamic_slice`` / ``lax.dynamic_update_slice`` outside
+    the incremental seam (``spartan_tpu/expr/incremental.py``) — the
+    plan-auditor PR: with traced start indices GSPMD cannot prove the
+    slice stays inside one shard, so it ALL-GATHERS the full sharded
+    operand onto every chip before slicing — the pathological
+    communication class ``st.audit_plan`` exists to flag
+    (analysis/plan_audit.py, finding kind ``full_gather``). The
+    incremental engine's stash path is the ONE sanctioned
+    construction site: it pays the gather knowingly, on the
+    delta-sized stash, never the full operand (docs/INCREMENTAL.md).
+    The static-bound forms (``dynamic_slice_in_dim`` on unsharded
+    axes, ``lax.slice``) are fine and not flagged.
+
+Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings;
+``--json`` emits the findings as a JSON array for CI tooling) or as a
+module (``python -m tools.lint_repo``) or through the tier-1 suite
+(tests/test_lint_repo.py).
 """
 
 from __future__ import annotations
@@ -284,6 +299,17 @@ _MUTATION_ALLOWED_DIRS = (os.path.join("spartan_tpu", "array")
 _MUTATION_ALLOWED_FILES = (
     os.path.join("spartan_tpu", "expr", "incremental.py"),)
 _MUTATION_ATTRS = {"_jax", "_lineage", "_version"}
+
+# rule 15: a traced-start dynamic slice on a sharded operand lowers
+# to a FULL all-gather of that operand (GSPMD cannot bound traced
+# indices to one shard) — the worst communication shape the plan
+# auditor flags (analysis/plan_audit.py). Only the incremental
+# engine's stash path may construct one, and only on delta-sized
+# data (docs/INCREMENTAL.md). Exact-name match: the *_in_dim
+# helpers and lax.slice have static bounds and are fine.
+_DYNSLICE_ALLOWED_FILES = (
+    os.path.join("spartan_tpu", "expr", "incremental.py"),)
+_DYNSLICE_ATTRS = {"dynamic_slice", "dynamic_update_slice"}
 
 
 class Finding:
@@ -608,6 +634,41 @@ def lint_raw_memory_stats(path: str, tree: ast.AST) -> List[Finding]:
                 "and the device_* gauges agree — use "
                 "obs.metrics.device_memory_aggregate() (all local "
                 "devices, max+sum), not a per-device probe"))
+    return findings
+
+
+def lint_dynamic_slices(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 15: no ``dynamic_slice`` / ``dynamic_update_slice``
+    outside the incremental engine's stash seam — with traced starts
+    on a sharded operand the lowering is a full all-gather, the
+    communication class the plan auditor flags as ``full_gather``."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _DYNSLICE_ALLOWED_FILES:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        attr = None
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DYNSLICE_ATTRS):
+            attr = node.func.attr
+        elif (isinstance(node, (ast.ImportFrom, ast.Import))):
+            names = {a.name for a in node.names}
+            hit = names & _DYNSLICE_ATTRS
+            if hit and getattr(node, "module", "") in (
+                    "jax.lax", "jax", "lax"):
+                attr = sorted(hit)[0]
+        if attr is not None:
+            findings.append(Finding(
+                path, node.lineno, "traced-start-slice",
+                f"{attr} outside the incremental seam: a traced-start "
+                "slice of a sharded operand lowers to a FULL "
+                "all-gather of that operand (st.audit_plan flags it "
+                "as full_gather) — only expr/incremental.py's "
+                "delta-sized stash path may pay that knowingly "
+                "(docs/INCREMENTAL.md); use static-bound slicing "
+                "(lax.slice / dynamic_slice_in_dim on unsharded "
+                "axes) or the incremental API instead"))
     return findings
 
 
@@ -952,12 +1013,20 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_pallas_imports(path, tree))
         findings.extend(lint_persist_seam(path, tree))
         findings.extend(lint_buffer_mutation(path, tree))
+        findings.extend(lint_dynamic_slices(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
 
-def main() -> int:
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
     findings = run_lint()
+    if "--json" in argv:
+        import json
+        print(json.dumps([{"path": f.path, "line": f.line,
+                           "rule": f.rule, "message": f.message}
+                          for f in findings], indent=2))
+        return 1 if findings else 0
     for f in findings:
         print(f)
     if findings:
