@@ -1,0 +1,191 @@
+"""Continuous-monitor acceptance gate (ISSUE 18): the closed-loop
+telemetry layer's toll on the serve hot path.
+
+Two measurements, one JSON line:
+
+* **off-path overhead** — steady-state solo submit/resolve round
+  trips through a running ``ServeEngine`` with the monitor layer
+  PRESENT but off (the production default: ``FLAGS.monitor`` False,
+  no sampler thread; the request path pays one memoized
+  ``slo.class_for`` lookup at submit, one ``slo.observe`` at resolve,
+  and one model-pricing flag read + ``ledger.predict_service_s`` per
+  worker pop) vs a null-shim arm with engine's ``slo_mod`` binding
+  and the pricing flag swapped out. ABBA-interleaved block pairs,
+  per-block medians, ``monitor_off_overhead_ratio`` = LOWER QUARTILE
+  of pairwise off/base block-median ratios - 1 (the redistribution/
+  warm-start/incremental/plan-audit/serving gates' estimator:
+  timesharing bursts are one-sided, so Q1 holds at the true ~0 ratio
+  under contamination while a systematic regression shifts every
+  pair). The committed gate is <=1% on both cpu and tpu; the median
+  rides along unjudged for drift comparison.
+* **daemon-on overhead** — the same round trips with ``FLAGS.monitor``
+  True and the 1 Hz sampler thread running (each tick snapshots
+  metrics + ledger + SLO windows + queue depth OFF the request path).
+  ``monitor_on_overhead_ratio`` is REPORTED, NOT GATED — the daemon's
+  cost is the knob's price, set by the operator. One directly-timed
+  ``monitor.sample()`` median (``sample_tick_us``) rides the record
+  as evidence of what a tick costs.
+
+Usage: python benchmarks/monitor_overhead.py [--iters K] [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import types
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(iters: int = 60, n: int = 512) -> dict:
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # same async-dispatch deadlock lottery serving_latency.py
+        # sidesteps: host threads dispatching onto 8 virtual devices
+        # sharing one core
+        try:
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
+        except (AttributeError, ValueError):
+            pass
+    import spartan_tpu as st
+    from spartan_tpu.obs import monitor as monitor_mod
+    from spartan_tpu.obs import slo as slo_mod
+    from spartan_tpu.serve import engine as engine_mod
+    from spartan_tpu.utils import profiling
+
+    rng = np.random.RandomState(0)
+    x = st.as_expr(rng.rand(n, n).astype(np.float32)).evaluate()
+    y = st.as_expr(rng.rand(n, n).astype(np.float32)).evaluate()
+    xe, ye = st.as_expr(x), st.as_expr(y)
+    scalar = iter(range(1, 10_000_000))
+
+    def build():
+        # per-request weak-typed scalar: same plan signature every
+        # time (steady-state hit path), distinct answer per request
+        return (xe + ye).sum() * float(next(scalar))
+
+    st.serve.shutdown_default()
+    engine = st.ServeEngine(workers=1, batch_window_s=0.0)
+    engine.start()
+    for _ in range(3):  # solo plan + executable warm
+        engine.submit(build()).result(timeout=300)
+
+    def step():
+        engine.submit(build()).result(timeout=300)
+
+    # the null shims: what the serve path looked like before the
+    # monitor layer grew its seams. class_for/observe collapse to
+    # no-ops and the pricing flag reads False, so a 'base' request
+    # runs the pre-ISSUE-18 pop/dispatch/resolve code
+    real_slo, real_pricing = engine_mod.slo_mod, engine_mod._MODEL_PRICING_FLAG
+    shim_slo = types.SimpleNamespace(
+        class_for=lambda tenant: None,
+        observe=lambda tenant, latency_s: None)
+    shim_pricing = types.SimpleNamespace(_value=False)
+
+    block = 8
+    times: dict = {"base": [], "off": [], "on": []}
+
+    def run_block(arm: str) -> float:
+        if arm == "base":
+            engine_mod.slo_mod = shim_slo
+            engine_mod._MODEL_PRICING_FLAG = shim_pricing
+        else:
+            engine_mod.slo_mod = real_slo
+            engine_mod._MODEL_PRICING_FLAG = real_pricing
+        step()  # absorb the arm switch
+        ts = []
+        for _ in range(block):
+            with profiling.stopwatch() as sw:
+                step()
+            ts.append(sw.elapsed)
+        times[arm].extend(ts)
+        return float(np.median(ts))
+
+    pair_ratios: list = []
+    on_ratios: list = []
+    pairs = max(8, iters // (2 * block))
+    try:
+        run_block("base"), run_block("off")  # position warmup
+        for i in range(pairs):
+            # adjacent blocks share the box's instantaneous load;
+            # ABBA ordering cancels second-position effects
+            if i % 2 == 0:
+                t_b, t_o = run_block("base"), run_block("off")
+            else:
+                t_o, t_b = run_block("off"), run_block("base")
+            pair_ratios.append(t_o / t_b)
+
+        # -- daemon-on: sampler thread running, reported unjudged ----
+        prev_monitor = st.FLAGS.monitor
+        prev_interval = st.FLAGS.monitor_interval_s
+        st.FLAGS.monitor = True
+        st.FLAGS.monitor_interval_s = 0.05  # worst-case cadence
+        monitor_mod.start()
+        try:
+            run_block("on")  # warm the sampler's first tick
+            for i in range(max(4, pairs // 2)):
+                if i % 2 == 0:
+                    t_o, t_n = run_block("off"), run_block("on")
+                else:
+                    t_n, t_o = run_block("on"), run_block("off")
+                on_ratios.append(t_n / t_o)
+        finally:
+            monitor_mod.stop()
+            st.FLAGS.monitor = prev_monitor
+            st.FLAGS.monitor_interval_s = prev_interval
+
+        # one tick, timed directly (what the daemon pays per sample,
+        # off the request path)
+        tick = []
+        for _ in range(20):
+            with profiling.stopwatch() as sw:
+                monitor_mod.sample()
+            tick.append(sw.elapsed)
+        sample_tick_us = float(np.median(tick)) * 1e6
+    finally:
+        engine_mod.slo_mod = real_slo
+        engine_mod._MODEL_PRICING_FLAG = real_pricing
+        engine.stop()
+        st.serve.shutdown_default()
+        monitor_mod.MONITOR.reset()
+        slo_mod.reset()
+
+    t_base = float(np.median(times["base"]))
+    t_off = float(np.median(times["off"]))
+    off_ratio = float(np.percentile(pair_ratios, 25)) - 1.0
+    off_ratio_median = float(np.median(pair_ratios)) - 1.0
+    on_ratio = float(np.percentile(on_ratios, 25)) - 1.0
+
+    return {
+        "metric": "monitor_overhead",
+        "n": n,
+        "block": block,
+        "pairs": len(pair_ratios),
+        "wall_us_per_iter_base": round(t_base * 1e6, 1),
+        "wall_us_per_iter_monitor_off": round(t_off * 1e6, 1),
+        "monitor_off_overhead_ratio": round(max(0.0, off_ratio), 4),
+        "monitor_off_overhead_ratio_median": round(
+            max(0.0, off_ratio_median), 4),
+        "monitor_on_overhead_ratio": round(max(0.0, on_ratio), 4),
+        "sample_tick_us": round(sample_tick_us, 1),
+    }
+
+
+def main() -> None:
+    kw = {}
+    if "--iters" in sys.argv:
+        kw["iters"] = int(sys.argv[sys.argv.index("--iters") + 1])
+    if "--small" in sys.argv:
+        kw["n"] = 128
+        kw.setdefault("iters", 32)
+    print(json.dumps(measure(**kw)))
+
+
+if __name__ == "__main__":
+    main()

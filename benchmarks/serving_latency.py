@@ -27,15 +27,19 @@ Three measurements, one JSON line:
   (``plan_cache_max=0``, LRU reorder skipped) and no engine; 'off'
   arm = default bounded LRU cache with the default engine started but
   idle (its workers park on the queue's condition variable — zero
-  steady-state CPU). ``serve_off_overhead_ratio`` = median of
-  pairwise off/base block-MEDIAN ratios - 1, over >=8 ABBA-interleaved
-  block pairs (the ISSUE-9 de-flake: the per-block MIN this replaced
-  let one lucky fast base iteration swing the committed ratio
-  0.0<->0.03 on the 1-core CPU box; a median-of-k block statistic is
-  robust to a single outlier in either direction). The committed gate
-  is <=2% (re-committed with the de-flake for both cpu and tpu: the
-  true difference is ~0 and the estimate still wobbles ~1% on a
-  timesharing box).
+  steady-state CPU). ``serve_off_overhead_ratio`` = LOWER
+  QUARTILE of pairwise off/base block-MEDIAN ratios - 1, over >=8
+  ABBA-interleaved block pairs. Two de-flake generations: ISSUE 9
+  replaced the per-block MIN (one lucky fast base iteration swung the
+  committed ratio 0.0<->0.03 on the 1-core CPU box) with per-block
+  medians; ISSUE 18 moved the cross-pair statistic from the median to
+  Q1 — the estimator every later overhead gate (redistribution,
+  warm-start, incremental, plan-audit) adopted: timesharing bursts
+  are one-sided (they only ADD time to whichever block they hit), so
+  Q1 stays at the true ~0 ratio under burst contamination while a
+  REAL off-path regression still shifts every pair and trips the
+  gate. The committed gate is <=2% on both cpu and tpu; the median
+  rides along unjudged for drift comparison.
 
 The workload is ``(x + y).sum() * s`` on shared array leaves with a
 per-request scalar ``s`` (scalars are weak-typed leaves outside the
@@ -232,7 +236,12 @@ def measure(clients: int = 16, per_client: int = 30, reps: int = 5,
         st.serve.shutdown_default()
     t_base = float(np.median(times["base"]))
     t_off = float(np.median(times["off"]))
-    off_ratio = float(np.median(pair_ratios)) - 1.0
+    # lower-quartile estimator (the redistribution/warm-start/
+    # incremental/plan-audit gates' statistic): box-load bursts are
+    # one-sided — Q1 holds at the true ratio under contamination, a
+    # systematic off-path cost still shifts every pair
+    off_ratio = float(np.percentile(pair_ratios, 25)) - 1.0
+    off_ratio_median = float(np.median(pair_ratios)) - 1.0
 
     def pct(q: float) -> float:
         if not lat:
@@ -257,6 +266,8 @@ def measure(clients: int = 16, per_client: int = 30, reps: int = 5,
         "wall_us_per_iter_base": round(t_base * 1e6, 1),
         "wall_us_per_iter_serve_off": round(t_off * 1e6, 1),
         "serve_off_overhead_ratio": round(max(0.0, off_ratio), 4),
+        "serve_off_overhead_ratio_median": round(
+            max(0.0, off_ratio_median), 4),
     }
 
 

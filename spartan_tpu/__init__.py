@@ -28,15 +28,15 @@ from .parallel import collectives
 from .parallel import mesh as _mesh
 from .parallel.mesh import (StaleMeshError, build_mesh, get_mesh,
                             initialize_distributed, mesh_epoch,
-                            rebuild_mesh, set_mesh, status, use_mesh)
+                            rebuild_mesh, set_mesh, use_mesh)
 from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import PlanAudit, audit_plan, check, lint
 from . import obs
 from .obs import (AuditReport, CalibrationProfile, DeviceProfile,
                   ExplainReport, Watchpoint, audit, explain,
-                  fit_profile, load_profile, loop_health, metrics,
-                  save_profile, trace_clear, trace_events,
-                  trace_export, unwatch, watch)
+                  fit_profile, fleet_status, load_profile, loop_health,
+                  metrics, save_profile, status, trace_clear,
+                  trace_events, trace_export, unwatch, watch)
 from . import resilience
 from .resilience import ChaosPlan, FatalMeshError, chaos, chaos_clear
 from . import serve
@@ -51,7 +51,8 @@ __version__ = "0.1.0"
 __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "Tiling", "FLAGS",
             "build_mesh", "get_mesh", "set_mesh", "use_mesh", "initialize",
-            "initialize_distributed", "shutdown", "status", "collectives",
+            "initialize_distributed", "shutdown", "status",
+            "fleet_status", "collectives",
             "rebuild_mesh", "mesh_epoch", "StaleMeshError",
             "checkpoint", "profiling", "stencil", "maxpool", "avgpool",
             "check", "lint", "audit_plan", "PlanAudit",

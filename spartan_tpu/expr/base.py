@@ -31,6 +31,7 @@ from ..array.distarray import DistArray
 from ..array.tiling import Tiling
 from ..kernels import registry as kernels_mod
 from ..obs import ledger as ledger_mod
+from ..obs import monitor as monitor_mod
 from ..obs import numerics as numerics_mod
 from ..obs import profile as profile_mod
 from ..obs.explain import build_plan_report, key_hash, scope_digest_table
@@ -1657,6 +1658,13 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
     # (DP cost + per-class components, modeled peak HBM) so measured
     # dispatch times land next to them. Miss-path only.
     ledger_mod.note_plan(ledger_plan)
+    # autotune hot-plan templates (obs/monitor.py): under the
+    # re-calibration daemon, remember a result-free clone of this
+    # miss's raw DAG keyed by its ledger digest so drift-triggered
+    # replans run off the hot path. One flag read when the daemon is
+    # off — and miss-path only, like the ledger hook above.
+    if monitor_mod._AUTOTUNE_FLAG._value:
+        monitor_mod.note_plan_built(ledger_plan, expr)
     # the auditor's digest -> node join table, computed LAST: the
     # memory/ledger walks above stamp tiling decisions onto nodes, and
     # the digest must hash the same node state the trace-time naming

@@ -33,6 +33,15 @@ FLAGS-gated cProfile dumps — SURVEY.md §5):
   with optional on-device early exit, and the dispatch watchdog
   (``FLAGS.dispatch_timeout_s`` -> crash dump with the in-flight span
   tree).
+* :mod:`slo` — per-tenant latency SLO classes for the serve path
+  (``FLAGS.serve_slo_classes``): windowed violation tracking and the
+  ``slo_burn_rate{slo_class=...}`` gauges.
+* :mod:`monitor` — the closed loop: continuous sampler + bounded
+  time-series store, typed drift/burn/fallback/backpressure anomaly
+  detectors, and the autotune daemon (``FLAGS.monitor_autotune``)
+  that refits calibration factors from the live ledger and hot-swaps
+  re-planned executables behind a hysteresis margin. ``st.status()``
+  / ``st.fleet_status()`` render from here.
 
 Import discipline: ``obs`` sits BELOW the expr/array layers (only
 ``utils/config`` above it), so every subsystem can emit spans/metrics
@@ -43,8 +52,10 @@ expr layer lazily.
 from . import flight
 from . import ledger as _ledger_mod
 from . import metrics as _metrics_mod
+from . import monitor
 from . import numerics
 from . import profile
+from . import slo
 from . import trace as _trace_mod
 from .explain import ExplainReport, explain
 from .ledger import (CalibrationProfile, fit_profile, load_profile,
@@ -60,6 +71,8 @@ from .trace import Span, span
 # st.ledger() / st.flightrec())
 ledger = _ledger_mod
 metrics = _metrics_mod.snapshot
+status = monitor.status
+fleet_status = monitor.fleet_status
 ledger_snapshot = _ledger_mod.snapshot
 flightrec = flight.snapshot
 trace_export = _trace_mod.export
@@ -73,4 +86,5 @@ __all__ = ["span", "Span", "trace_export", "trace_events", "trace_clear",
            "Watchpoint", "loop_health", "dump_crash",
            "ledger", "ledger_snapshot", "flight", "flightrec",
            "CalibrationProfile", "fit_profile", "save_profile",
-           "load_profile", "profile", "DeviceProfile"]
+           "load_profile", "profile", "DeviceProfile",
+           "monitor", "slo", "status", "fleet_status"]

@@ -337,6 +337,37 @@ def ingest(digest: str, components: Dict[str, float],
             e.dispatch_min_s = measured_s
 
 
+def predict_service_s(digest: Optional[str]) -> Optional[float]:
+    """Calibrated service-time estimate for one plan digest: the
+    entry's DP cost priced through the warmed seconds-per-cost-unit
+    EMA (``_dp_state``). None until the scale has warmed (8 dispatch
+    samples) or when the digest has no priced entry — callers
+    (``serve/engine``'s model-priced shedding, ``obs/monitor``) fall
+    back to the queue EMA. O(1) under the ledger lock."""
+    if not _LEDGER_FLAG._value or digest is None:
+        return None
+    with _lock:
+        if _dp_state["n"] < 8:
+            return None
+        e = _entries.get(digest)
+        if e is None or not e.dp_cost or e.dp_cost <= 0:
+            return None
+        return float(e.dp_cost) * math.exp(_dp_state["log_scale"])
+
+
+def components_of(digest: Optional[str]) -> Optional[Dict[str, float]]:
+    """The recorded per-op-class cost decomposition for one digest
+    (a copy), or None. The autotune daemon (``obs/monitor``) reprices
+    an incumbent plan under a candidate profile from these."""
+    if digest is None:
+        return None
+    with _lock:
+        e = _entries.get(digest)
+        if e is None or not e.components:
+            return None
+        return dict(e.components)
+
+
 # -- the snapshot (st.ledger) --------------------------------------------
 
 

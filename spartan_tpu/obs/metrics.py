@@ -259,17 +259,43 @@ class Registry:
         with self._lock:
             return {k: c._value for k, c in self._counters.items()}
 
-    def snapshot(self) -> Dict[str, Any]:
-        """JSON-ready view of every instrument."""
+    def snapshot(self, reset: bool = False) -> Dict[str, Any]:
+        """JSON-ready view of every instrument, taken under ONE lock
+        hold so counters, gauges and histogram summaries all come from
+        the same instant — the monitor's sampler (obs/monitor.py) reads
+        this concurrently with serve workers recording, and the old
+        take-the-list-then-summarize shape could pair a counter from T0
+        with a histogram from T1. The histogram summaries are computed
+        inline (the shared lock is not reentrant; ``Histogram.summary``
+        would deadlock here). ``reset=True`` zeroes every instrument
+        inside the same critical section: the read-and-reset is atomic,
+        so no concurrent increment can land between the read and the
+        zero and be lost — the ``st.metrics(reset=True)`` delta-scrape
+        contract."""
         with self._lock:
             counters = {k: c._value for k, c in self._counters.items()}
             gauges = {k: {"value": g._value, "max": g._max}
                       for k, g in self._gauges.items()}
-            hists = list(self._hists.values())
+            hists: Dict[str, Dict[str, float]] = {}
+            for k, h in self._hists.items():
+                samples = sorted(h._samples)
+                summ = {"count": h.count, "sum": h.total,
+                        "max": h.vmax}
+                if samples:
+                    summ["p50"] = _percentile(samples, 0.50)
+                    summ["p95"] = _percentile(samples, 0.95)
+                else:
+                    summ["p50"] = summ["p95"] = 0.0
+                hists[k] = summ
+            if reset:
+                for table in (self._counters, self._gauges,
+                              self._hists):
+                    for inst in table.values():
+                        inst._reset()
         return {
             "counters": counters,
             "gauges": gauges,
-            "histograms": {h.name: h.summary() for h in hists},
+            "histograms": hists,
         }
 
     def prometheus(self) -> str:
@@ -403,19 +429,26 @@ def _update_device_gauges() -> None:
             "devices)").set(agg["sum"])
 
 
-def snapshot(fmt: str = "json") -> Any:
+def snapshot(fmt: str = "json", reset: bool = False) -> Any:
     """The public ``st.metrics()``: registry snapshot plus derived
     plan-cache and device-memory views.
 
     ``fmt="json"`` (default) returns a dict; ``fmt="prometheus"``
-    returns Prometheus text exposition format."""
+    returns Prometheus text exposition format. ``reset=True`` zeroes
+    every instrument atomically with the read (delta scrapes: two
+    concurrent reset-scrapers never double-count or lose an
+    increment); for the prometheus format the reset happens after the
+    render (the exposition path reads the registry twice)."""
     _update_device_gauges()
     if fmt == "prometheus":
-        return REGISTRY.prometheus()
+        text = REGISTRY.prometheus()
+        if reset:
+            REGISTRY.reset()
+        return text
     if fmt != "json":
         raise ValueError(f"unknown metrics format {fmt!r} "
                          "(expected 'json' or 'prometheus')")
-    snap = REGISTRY.snapshot()
+    snap = REGISTRY.snapshot(reset=reset)
     c = snap["counters"]
     hits = c.get("plan_hits", 0)
     misses = c.get("plan_misses", 0)

@@ -815,11 +815,19 @@ def dump_crash(path: Optional[str] = None, reason: str = "",
         ledger: Optional[Dict[str, Any]] = ledger_mod.snapshot()
     except Exception:  # noqa: BLE001
         ledger = None
+    try:
+        from . import monitor as monitor_mod
+
+        monitor: Optional[Dict[str, Any]] = monitor_mod.crash_section()
+    except Exception:  # noqa: BLE001 - the monitor section is
+        # advisory like the flightrec/ledger ones above
+        monitor = None
     doc: Dict[str, Any] = {
         "reason": reason,
         "pid": os.getpid(),
         "flightrec": flightrec,
         "ledger": ledger,
+        "monitor": monitor,
         # the non-default FLAGS in force when the process died: lets a
         # post-mortem attribute a regression/hang to a flag default
         # (ROADMAP r05 cold-start suspicion) without re-running

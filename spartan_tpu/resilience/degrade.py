@@ -130,6 +130,52 @@ def clone_for_replan(root: Any) -> Any:
     return go(root)
 
 
+# -- autotune replan/warm (obs/monitor's daemon) ------------------------
+
+
+def replan_for_profile(template: Any, mesh) -> Optional[Any]:
+    """Re-plan a result-free template DAG under the CURRENTLY
+    installed calibration profile — optimizer-only (the governor
+    pattern): sign a fresh clone, look the key up, build on a miss.
+    No compile, no dispatch. The fingerprint flag is part of the plan
+    key, so the challenger lands in the plan cache WITHOUT touching
+    the incumbent. Returns the (possibly cached) plan, or None when
+    the structure is uncacheable."""
+    from ..expr import base
+
+    clone = clone_for_replan(template)
+    plan_key, rctx = base.plan_signature(clone, mesh)
+    plan = base.lookup_plan(plan_key)
+    if plan is None:
+        plan, _dag, _leaves = base._build_plan(clone, mesh, rctx,
+                                               plan_key)
+    return plan
+
+
+def warm_evaluate(template: Any, mesh) -> bool:
+    """Speculatively evaluate a fresh clone of ``template`` off the
+    hot path (the autotune daemon's challenger warm-up): the dispatch
+    compiles the re-planned executable so the first re-keyed hot-path
+    request is a pure cache hit. Advisory — any failure is swallowed
+    (counted) and the swap stands on the modeled win alone."""
+    from ..expr import base
+    from ..parallel import mesh as mesh_mod
+
+    clone = clone_for_replan(template)
+    try:
+        with mesh_mod.use_mesh(mesh):
+            base.evaluate(clone)
+        return True
+    except Exception:  # noqa: BLE001 - warm-up is advisory; the
+        # resilience engine already classified/retried inside evaluate
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "monitor_warm_failures",
+                "autotune challenger warm-up evaluations that failed "
+                "(advisory; the hot-swap decision is model-based)").inc()
+        return False
+
+
 # -- rung 1/2: forced finer tiling --------------------------------------
 
 

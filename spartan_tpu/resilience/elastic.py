@@ -235,6 +235,14 @@ def on_fatal_mesh(exc: BaseException, mesh: Any = None) -> Optional[Any]:
                 # next handle_failure runs _finish_recovery
                 _fire_recover()
                 new_mesh = mesh_mod.rebuild_mesh(exclude_devices=lost)
+            # fence the continuous monitor NOW (obs/monitor.py): its
+            # detector streaks and the autotune daemon's hot-plan
+            # templates reference the dead epoch — waiting for the
+            # sampler to notice the epoch bump would let a refit
+            # racing this recovery replan onto dead devices
+            from ..obs import monitor as monitor_mod
+
+            monitor_mod.notify_mesh_recovery()
             from ..expr import base as expr_base
 
             with prof.phase("evict"):

@@ -50,6 +50,7 @@ from ..obs import numerics as numerics_mod
 from ..obs import profile as profile_mod
 from ..obs import trace as trace_mod
 from ..obs.explain import key_hash
+from ..obs import slo as slo_mod
 from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY, labeled
 from ..parallel import mesh as mesh_mod
@@ -88,6 +89,14 @@ FLAGS.define_bool(
     "Coalesce identical-signature requests into leading-axis batched "
     "dispatches (one compile, one dispatch, N responses). Off = every "
     "request dispatches solo (still async, still admission-controlled).")
+_MODEL_PRICING_FLAG = FLAGS.define_bool(
+    "serve_model_pricing", True,
+    "Price service-time predictions (deadline shedding, the ledger's "
+    "service rows) with the calibrated cost model "
+    "(ledger.predict_service_s: the plan's DP cost through the warmed "
+    "seconds-per-cost-unit scale) instead of the raw queue EMA. Falls "
+    "back to the EMA per request until the scale warms or when the "
+    "plan has no priced entry.")
 _COMM_BUDGET_FLAG = FLAGS.define_int(
     "comm_budget_bytes", 0,
     "Communication-aware admission: when > 0, a submission whose plan "
@@ -363,6 +372,28 @@ class ServeEngine:
         donated = base._norm_donate(donate)
         req = _Request(expr, donated, tenant, deadline_s,
                        mesh_mod.get_mesh())
+        # SLO-class admission (obs/slo.py, docs/SERVING.md): a class
+        # with a queue share below 1.0 may only occupy that fraction
+        # of the admission queue — a bulk class cannot queue the
+        # latency class out. Same retryable Backpressure contract as
+        # depth shedding. One memoized-parse check when no classes
+        # are configured.
+        cls = slo_mod.class_for(tenant)
+        if cls is not None and cls.share < 1.0:
+            cap = max(1, int(self.queue.maxsize * cls.share))
+            if self.queue.depth() >= cap:
+                if _METRICS_FLAG._value:
+                    REGISTRY.counter(
+                        labeled("serve_slo_rejected",
+                                slo_class=cls.name),
+                        "submissions shed because their SLO class's "
+                        "queue share was exhausted").inc()
+                flight_mod.note(req.rid, "reject",
+                                reason="slo_admission",
+                                slo_class=cls.name, share=cls.share)
+                raise Backpressure(
+                    self.queue.depth(),
+                    self.queue.retry_after_s(self.workers))
         # memory-aware admission (docs/MEMORY.md): when a budget is
         # known, a submission whose predicted peak cannot fit next to
         # the in-flight reservations is rejected with the SAME
@@ -452,11 +483,14 @@ class ServeEngine:
             if req is None:
                 continue
             req.t_taken = trace_mod.now()
-            # the service-time PREDICTION for this request is the EMA
-            # as of pop — exactly what a Backpressure retry-after would
-            # have quoted; the cost ledger pairs it with the measured
-            # service below
-            predicted_s = self.queue.ema_service_s()
+            # the service-time PREDICTION for this request: the
+            # calibrated model's price for this plan when it has one
+            # (FLAGS.serve_model_pricing), else the queue EMA as of
+            # pop — exactly what a Backpressure retry-after would have
+            # quoted; the cost ledger pairs it with the measured
+            # service below either way, so the monitor's drift
+            # detector judges whichever predictor actually ran
+            predicted_s = self._predict_service_s(req)
             with prof.stopwatch() as sw:
                 try:
                     self._service(req)
@@ -474,6 +508,15 @@ class ServeEngine:
                 if samp is not None:
                     flight_mod.note(req.rid, "profiled", **samp)
 
+    def _predict_service_s(self, r: "_Request") -> float:
+        """This request's service-time prediction: the calibrated
+        model's plan price when available, the queue EMA otherwise."""
+        if _MODEL_PRICING_FLAG._value:
+            p = ledger_mod.predict_service_s(key_hash(r.plan_key))
+            if p is not None and p > 0:
+                return p
+        return self.queue.ema_service_s()
+
     def _shed_expired(self, batch: List[_Request]) -> List[_Request]:
         live: List[_Request] = []
         for r in batch:
@@ -488,8 +531,31 @@ class ServeEngine:
                 r.future._reject(DeadlineExceeded(
                     f"deadline expired {-rem * 1e3:.1f}ms before "
                     f"dispatch (queued {trace_mod.now() - r.t_submit:.3f}s)"))
-            else:
-                live.append(r)
+                continue
+            if rem is not None and _MODEL_PRICING_FLAG._value:
+                # predictive shed: the calibrated model says this
+                # dispatch cannot finish inside the remaining budget —
+                # shed NOW instead of burning a doomed dispatch slot
+                # (the EMA-era behavior only shed already-expired
+                # requests). Model-priced only: the EMA's blend over
+                # unrelated plans is too blunt to pre-reject on.
+                pred = ledger_mod.predict_service_s(
+                    key_hash(r.plan_key))
+                if pred is not None and pred > rem:
+                    if _METRICS_FLAG._value:
+                        REGISTRY.counter(
+                            "serve_predicted_shed",
+                            "requests shed because the calibrated "
+                            "model priced their dispatch past the "
+                            "remaining deadline").inc()
+                    flight_mod.note(r.rid, "shed", reason="predicted",
+                                    predicted_s=round(pred, 6),
+                                    remaining_s=round(rem, 6))
+                    r.future._reject(DeadlineExceeded(
+                        f"predicted service {pred * 1e3:.1f}ms exceeds "
+                        f"remaining deadline {rem * 1e3:.1f}ms"))
+                    continue
+            live.append(r)
         return live
 
     def _take(self, req: _Request, limit: int,
@@ -606,7 +672,12 @@ class ServeEngine:
                         status: str) -> None:
         """One resolution record: the request's latency decomposition
         (queue-wait / coalesce-wait / dispatch) lands in its flight
-        record and the per-tenant histograms."""
+        record and the per-tenant histograms; the end-to-end latency
+        feeds the tenant's SLO class (obs/slo.py) regardless of the
+        flight-recorder flag."""
+        if r.future.t_resolved is not None:
+            slo_mod.observe(r.tenant,
+                            r.future.t_resolved - r.t_submit)
         if not flight_mod._FLIGHT_FLAG._value:
             return
         flight_mod.record_resolution(

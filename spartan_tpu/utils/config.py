@@ -259,6 +259,32 @@ FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
 #       auto (XPlane capture-parse, replay fallback) | xplane | replay.
 #   profile_max_nodes (obs/profile.py, default 128) — replay-tier
 #       node budget per plan.
+#   serve_slo_classes / serve_slo_tenants / serve_slo_window
+#       (obs/slo.py, defaults "" / "" / 256) — per-tenant latency SLO
+#       classes ('name=target_s@objective[:queue_share]'), the tenant
+#       -> class map, and the per-class violation window behind the
+#       slo_burn_rate gauges + serve SLO-share admission
+#       (docs/SERVING.md).
+#   monitor / monitor_interval_s / monitor_window (obs/monitor.py,
+#       defaults False / 1.0 / 512) — the continuous sampler thread,
+#       its cadence, and the bounded time-series store
+#       (benchmarks/monitor_overhead.py <=1% off-path gate).
+#   monitor_autotune / monitor_drift_patience / monitor_swap_margin /
+#       monitor_cooldown_s (obs/monitor.py, defaults False / 3 / 0.05
+#       / 30.0) — the closed-loop re-calibration daemon: sustained-
+#       drift patience, the modeled-win hysteresis a refitted profile
+#       must clear to hot-swap, and the post-attempt cooldown
+#       (docs/OBSERVABILITY.md).
+#   monitor_burn_threshold / monitor_fallback_rate (obs/monitor.py,
+#       defaults 1.0 / 5.0) — detector thresholds for SLO burn and
+#       fallback-counter spikes.
+#   monitor_fleet_dir    (obs/monitor.py, default "") — rank-snapshot
+#       directory behind st.fleet_status() (atomic per-rank files,
+#       rank-0 merge).
+#   serve_model_pricing  (serve/engine.py, default True) — price
+#       deadline shedding + the ledger's service rows with the
+#       calibrated cost model instead of the raw queue EMA (falls
+#       back per request until the DP scale warms).
 # The resilience layer's switches (spartan_tpu/resilience/) likewise
 # live with their consumers (docs/RESILIENCE.md):
 #   resilience           (engine.py, default True)  — master switch for

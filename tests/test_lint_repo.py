@@ -567,3 +567,41 @@ def test_module_entry_point():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     import json
     assert json.loads(proc.stdout) == []
+
+
+def test_catches_background_threads_outside_seams(tmp_path):
+    bad = tmp_path / "bad_thread.py"
+    bad.write_text(
+        "import threading\n"
+        "from threading import Thread\n"
+        "from threading import Timer\n"
+        "t = threading.Thread(target=work, daemon=True)\n"
+        "w = threading.Timer(5.0, fire)\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_background_threads(str(bad), tree)
+    assert sum(f.rule == "background-thread" for f in findings) == 4
+    assert all("epoch fence" in f.message for f in findings)
+    # synchronization primitives are NOT threads of execution
+    ok = ast.parse("import threading\n"
+                   "lock = threading.Lock()\n"
+                   "ev = threading.Event()\n"
+                   "cv = threading.Condition(lock)\n"
+                   "tl = threading.local()\n")
+    assert lint_repo.lint_background_threads("/x/y.py", ok) == []
+
+
+def test_background_threads_allowed_in_seams():
+    tree = ast.parse("import threading\n"
+                     "t = threading.Thread(target=run, daemon=True)\n"
+                     "w = threading.Timer(1.0, fire)\n")
+    for rel in (os.path.join("spartan_tpu", "serve", "engine.py"),
+                os.path.join("spartan_tpu", "resilience", "drill.py"),
+                os.path.join("spartan_tpu", "obs", "monitor.py"),
+                os.path.join("spartan_tpu", "obs", "numerics.py"),
+                os.path.join("spartan_tpu", "persist", "__init__.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_background_threads(path, tree) == []
+    # the same construction in any other obs module is a finding
+    other = os.path.join(lint_repo.REPO, "spartan_tpu", "obs",
+                         "trace.py")
+    assert lint_repo.lint_background_threads(other, tree) != []

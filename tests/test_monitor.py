@@ -1,0 +1,595 @@
+"""Closed-loop telemetry (ISSUE 18): SLO classes + burn windows, the
+continuous monitor's detector matrix, epoch fencing, and the autotune
+daemon's refit -> replan -> hysteresis-gated hot-swap chain — plan-key
+separation and bit-stable numerics included."""
+
+import json
+
+import numpy as np
+import pytest
+
+import spartan_tpu as st
+from spartan_tpu.array import distarray as da
+from spartan_tpu.array import tiling as tiling_mod
+from spartan_tpu.expr import base
+from spartan_tpu.obs import ledger
+from spartan_tpu.obs import monitor
+from spartan_tpu.obs import slo
+from spartan_tpu.obs import trace as trace_mod
+from spartan_tpu.obs.explain import key_hash
+from spartan_tpu.obs.metrics import REGISTRY, labeled
+from spartan_tpu.parallel import mesh as mesh_mod
+from spartan_tpu.serve import engine as engine_mod
+from spartan_tpu.serve.future import Backpressure, DeadlineExceeded
+from spartan_tpu.utils.config import FLAGS
+
+_SAVED = (
+    "serve_slo_classes", "serve_slo_tenants", "serve_slo_window",
+    "monitor", "monitor_interval_s", "monitor_window",
+    "monitor_autotune", "monitor_drift_patience",
+    "monitor_swap_margin", "monitor_cooldown_s",
+    "monitor_burn_threshold", "monitor_fallback_rate",
+    "monitor_fleet_dir", "cost_ledger", "cost_calibration",
+    "cost_calibration_fingerprint", "calibration_drift_tol",
+    "serve_model_pricing",
+)
+
+
+@pytest.fixture(autouse=True)
+def _setup(mesh1d):
+    saved = {n: getattr(FLAGS, n) for n in _SAVED}
+    FLAGS.cost_ledger = True
+    monitor.MONITOR.stop()
+    monitor.MONITOR.reset()
+    ledger.set_profile(None)
+    ledger.reset()
+    slo.reset()
+    st.serve.shutdown_default()
+    trace_mod.clear()
+    yield
+    monitor.MONITOR.stop()
+    monitor.MONITOR.reset()
+    st.serve.shutdown_default()
+    ledger.set_profile(None)
+    ledger.reset()
+    slo.reset()
+    for n, v in saved.items():
+        setattr(FLAGS, n, v)
+
+
+def _trace_names():
+    return [s.name for s in trace_mod.events()]
+
+
+# -- SLO classes + burn windows ------------------------------------------
+
+
+def test_slo_class_parsing_matrix():
+    FLAGS.serve_slo_classes = (
+        "gold=0.05@0.999:0.25, bulk=2.0, nonsense")
+    FLAGS.serve_slo_tenants = "t1=gold, svc=bulk"
+    table = slo.classes()
+    assert set(table) == {"gold", "bulk"}
+    g = table["gold"]
+    assert (g.target_s, g.objective, g.share) == (0.05, 0.999, 0.25)
+    b = table["bulk"]
+    assert (b.target_s, b.objective, b.share) == (2.0, 0.99, 1.0)
+    assert abs(g.budget() - 0.001) < 1e-12
+
+    assert slo.class_for("t1").name == "gold"
+    assert slo.class_for("svc").name == "bulk"
+    # unmapped tenant with no 'default' class: untracked
+    assert slo.class_for("stranger") is None
+    assert slo.class_for(None) is None
+
+    # a declared 'default' class catches every unmapped tenant
+    FLAGS.serve_slo_classes = "default=1.0@0.9"
+    assert slo.class_for("stranger").name == "default"
+    # objective is clamped below 1.0 (the budget can never be zero)
+    FLAGS.serve_slo_classes = "x=1.0@1.0"
+    assert slo.classes()["x"].objective <= 0.999999
+    FLAGS.serve_slo_classes = ""
+    assert slo.classes() == {}
+    assert slo.class_for("t1") is None
+
+
+def test_slo_burn_tracking_and_prometheus_export():
+    FLAGS.serve_slo_classes = "gold=0.001@0.9"
+    FLAGS.serve_slo_tenants = "hot=gold"
+    FLAGS.serve_slo_window = 16
+    for _ in range(10):
+        slo.observe("hot", 0.05)  # every one a violation
+    slo.observe("untracked-tenant", 0.05)  # no-op
+
+    burns = slo.burn_rates()
+    rec = burns["gold"]
+    assert rec["window"] == 10
+    assert rec["violation_rate"] == pytest.approx(1.0)
+    # burn = violation rate over the 10% error budget
+    assert rec["burn_rate"] == pytest.approx(10.0)
+    assert rec["target_s"] == 0.001 and rec["queue_share"] == 1.0
+
+    assert REGISTRY.counter(
+        labeled("slo_requests_total", slo_class="gold")).value == 10
+    assert REGISTRY.counter(
+        labeled("slo_violations_total", slo_class="gold")).value == 10
+
+    text = st.metrics(fmt="prometheus")
+    assert "# HELP spartan_slo_burn_rate " in text
+    assert "# TYPE spartan_slo_burn_rate gauge" in text
+    assert 'spartan_slo_burn_rate{slo_class="gold"}' in text
+
+
+def test_slo_window_is_bounded():
+    FLAGS.serve_slo_classes = "gold=10.0@0.9"
+    FLAGS.serve_slo_tenants = "hot=gold"
+    FLAGS.serve_slo_window = 8
+    for _ in range(8):
+        slo.observe("hot", 100.0)  # violations fill the window
+    for _ in range(8):
+        slo.observe("hot", 0.0)  # then healthy samples evict them
+    rec = slo.burn_rates()["gold"]
+    assert rec["window"] == 8
+    assert rec["violation_rate"] == pytest.approx(0.0)
+    assert rec["burn_rate"] == pytest.approx(0.0)
+
+
+# -- the detector matrix --------------------------------------------------
+
+
+def test_sustained_detector_patience_and_no_reemit():
+    FLAGS.monitor_drift_patience = 3
+    d = monitor._SustainedDetector("test_kind")
+    breach = {"k": (5.0, 1.0, True, "hot")}
+    calm = {"k": (0.5, 1.0, False, "ok")}
+    assert d.feed(0.0, breach) == []
+    assert d.feed(1.0, breach) == []
+    out = d.feed(2.0, breach)
+    assert len(out) == 1 and out[0].kind == "test_kind"
+    assert out[0].key == "k" and out[0].value == 5.0
+    # still breached: the streak keeps counting, no re-emit
+    assert d.feed(3.0, breach) == []
+    assert d.feed(4.0, breach) == []
+    # recovery resets; a fresh sustained breach emits ONE more
+    assert d.feed(5.0, calm) == []
+    assert d.streak("k") == 0
+    assert d.feed(6.0, breach) == []
+    assert d.feed(7.0, breach) == []
+    assert len(d.feed(8.0, breach)) == 1
+
+
+def test_sustained_detector_oscillation_never_emits():
+    FLAGS.monitor_drift_patience = 2
+    d = monitor._SustainedDetector("test_kind")
+    for i in range(10):
+        obs = {"k": (1.0, 1.0, i % 2 == 0, "flap")}
+        assert d.feed(float(i), obs) == []
+
+
+def test_fallback_detector_primes_then_spikes():
+    FLAGS.monitor_drift_patience = 1
+    FLAGS.monitor_fallback_rate = 2.0
+    d = monitor._FallbackDetector()
+    assert d.observe(0.0, {"serve_solo_fallbacks": 100}) == []  # prime
+    out = d.observe(1.0, {"serve_solo_fallbacks": 105})
+    assert len(out) == 1
+    assert out[0].kind == "fallback_spike"
+    assert out[0].key == "serve_solo_fallbacks"
+    assert out[0].value == 5.0
+    # steady counter: delta 0, below the rate — no anomaly
+    assert d.observe(2.0, {"serve_solo_fallbacks": 105}) == []
+    # a slow drip under the threshold never fires
+    assert d.observe(3.0, {"serve_solo_fallbacks": 106}) == []
+
+
+def test_backpressure_detector_needs_rejections_and_depth():
+    FLAGS.monitor_drift_patience = 1
+    d = monitor._BackpressureDetector()
+    assert d.observe(0.0, 0, 0) == []  # prime
+    out = d.observe(1.0, 3, 2)  # rejections grew, queue non-empty
+    assert len(out) == 1 and out[0].kind == "backpressure"
+    # rejections grew but the queue drained: a burst, not saturation
+    assert d.observe(2.0, 0, 5) == []
+
+
+def test_monitor_sample_emits_drift_anomaly():
+    FLAGS.calibration_drift_tol = 0.3
+    FLAGS.monitor_drift_patience = 2
+    for _ in range(6):  # predicted 5x the measured service time
+        ledger.note_service("drifting-plan", 0.5, 0.1)
+    assert monitor.sample() == []  # streak 1 of 2
+    out = monitor.sample()
+    assert len(out) == 1
+    a = out[0]
+    assert a.kind == "calibration_drift" and a.key == "service_time"
+    assert a.value == pytest.approx(5.0, rel=0.01)
+    assert list(monitor.MONITOR.anomalies)[-1] is a
+    # the series store sampled the ratio, the counter and trace fired
+    series = monitor.MONITOR.store.series(
+        "calibration_error_ratio:service_time")
+    assert series is not None and len(series.values()) == 2
+    assert REGISTRY.counter(labeled(
+        "monitor_anomalies_total",
+        kind="calibration_drift")).value >= 1
+    assert "anomaly" in _trace_names()
+    d = a.to_dict()
+    assert d["kind"] == "calibration_drift" and d["value"] == a.value
+
+
+def test_monitor_sample_emits_burn_anomaly():
+    FLAGS.serve_slo_classes = "gold=0.001@0.9"
+    FLAGS.serve_slo_tenants = "hot=gold"
+    FLAGS.monitor_burn_threshold = 1.0
+    FLAGS.monitor_drift_patience = 1
+    for _ in range(10):
+        slo.observe("hot", 1.0)
+    out = monitor.sample()
+    assert [a.kind for a in out] == ["slo_burn"]
+    assert out[0].key == "gold"
+    series = monitor.MONITOR.store.series("slo_burn_rate:gold")
+    assert series is not None
+    assert series.latest() == pytest.approx(10.0)
+
+
+# -- epoch fencing --------------------------------------------------------
+
+
+def test_epoch_fence_resets_streaks_and_templates():
+    FLAGS.calibration_drift_tol = 0.3
+    FLAGS.monitor_drift_patience = 5
+    for _ in range(4):
+        ledger.note_service("drifting-plan", 0.5, 0.1)
+    monitor.sample()
+    monitor.sample()
+    assert monitor.MONITOR.drift.streak("service_time") == 2
+    monitor.MONITOR.autotune.register("dead-digest", object())
+
+    before = REGISTRY.counter("monitor_epoch_fences").value
+    monitor.MONITOR._epoch_seen = mesh_mod.mesh_epoch() - 1
+    assert monitor.sample() == []  # fenced tick: quiet by design
+    assert monitor.MONITOR._epoch_seen == mesh_mod.mesh_epoch()
+    assert monitor.MONITOR.drift.streak("service_time") == 0
+    assert monitor.MONITOR.autotune.templates() == {}
+    assert REGISTRY.counter("monitor_epoch_fences").value == before + 1
+    assert "monitor_epoch_fence" in _trace_names()
+
+
+def test_notify_mesh_recovery_fences_immediately():
+    monitor.sample()  # prime the epoch
+    monitor.MONITOR.autotune.register("dead-digest", object())
+    monitor.notify_mesh_recovery()
+    assert monitor.MONITOR.autotune.templates() == {}
+    assert monitor.MONITOR._epoch_seen == mesh_mod.mesh_epoch()
+
+
+# -- the autotune daemon --------------------------------------------------
+
+
+def _synthetic_rows(true_factors, rows=12, seed=7, scale=1e-6):
+    rng = np.random.RandomState(seed)
+    classes = sorted(true_factors)
+    for i in range(rows):
+        comp = {c: float(rng.uniform(10.0, 100.0)) for c in classes}
+        measured = scale * sum(true_factors[c] * comp[c]
+                               for c in classes)
+        ledger.ingest(f"syn-{i}", comp, measured)
+
+
+def _events(kind=None):
+    evs = list(monitor.MONITOR.autotune.events)
+    return [e for e in evs if kind is None or e["event"] == kind]
+
+
+def test_autotune_skip_reasons_and_hysteresis():
+    FLAGS.monitor_cooldown_s = 50.0
+    auto = monitor.MONITOR.autotune
+
+    # empty ledger: nothing fittable, but the cooldown still starts
+    assert auto.attempt(0.0) is None
+    assert _events("skip")[-1]["reason"] == "nothing_fittable"
+    assert auto.state == "cooldown" and auto.in_cooldown(10.0)
+
+    # fittable skew but NO hot-plan templates: nothing replannable,
+    # the trial reverts and the incumbent (no profile) is restored
+    _synthetic_rows({"map": 1.0, "reshard": 4.0})
+    assert auto.attempt(100.0) == "revert"
+    rev = _events("revert")[-1]
+    assert rev["replanned"] == 0
+    assert ledger.active_profile() is None
+    assert FLAGS.cost_calibration is False
+    assert auto.last_rejected_fp == rev["fingerprint"]
+
+    # the rejected fingerprint is remembered: no flapping
+    assert auto.attempt(200.0) is None
+    assert _events("skip")[-1]["reason"] == "recently_rejected"
+
+    # tick() honors the cooldown: a fresh drift anomaly inside it
+    # only parks the state machine
+    n_events = len(_events())
+    anom = monitor.Anomaly("calibration_drift", "tiling_dp", 210.0,
+                           5.0, 0.3, "test")
+    auto.tick(210.0, [anom])
+    assert auto.state == "cooldown"
+    assert len(_events()) == n_events
+    # and with no anomalies outside the cooldown it goes idle
+    auto.tick(1000.0, [])
+    assert auto.state == "idle"
+
+
+def _gemm(n, seed=11):
+    """Row-tiled n x n gemm. Plan keys are STRUCTURAL (shape+tiling,
+    not values), so each test that needs its own plan-build miss —
+    the autotune template hook fires only there — uses a distinct n."""
+    rng = np.random.RandomState(seed)
+    a = da.from_numpy(rng.rand(n, n).astype(np.float32),
+                      tiling=tiling_mod.row(2))
+    b = da.from_numpy(rng.rand(n, n).astype(np.float32),
+                      tiling=tiling_mod.row(2))
+    return lambda: st.dot(st.as_expr(a), st.as_expr(b))
+
+
+def test_autotune_hot_swap_acceptance():
+    """The chaos-seeded mispriced-psum scenario: measurements say
+    output all-reduces cost ~10x the model's price. The daemon must
+    refit, replan the registered hot template under the candidate,
+    clear the hysteresis margin, hot-swap — and the re-keyed plan must
+    produce the same numbers."""
+    FLAGS.monitor_autotune = True
+    FLAGS.monitor_swap_margin = 0.05
+    build = _gemm(96)
+    key0 = base.plan_signature(build())[0]
+    v0 = np.asarray(build().glom())
+    # the plan-build miss registered a result-free template
+    assert key_hash(key0) in monitor.MONITOR.autotune.templates()
+
+    _synthetic_rows({"map": 1.0, "contraction": 1.0, "reshard": 1.0,
+                     "psum": 10.0})
+    assert monitor.MONITOR.autotune.attempt(0.0) == "swap"
+    ev = _events("swap")[-1]
+    assert ev["modeled_win"] >= 0.05
+    assert ev["replanned"] >= 1 and ev["warmed"] >= 1
+    assert _events("refit")  # refit precedes the swap in the log
+
+    # the candidate stayed installed: plans re-key (separation), the
+    # calibrated DP picks a different strategy, numerics are stable
+    assert FLAGS.cost_calibration is True
+    assert ledger.active_profile() is not None
+    key1 = base.plan_signature(build())[0]
+    assert key1 != key0
+    v1 = np.asarray(build().glom())
+    np.testing.assert_allclose(v0, v1, rtol=1e-5)
+    assert "autotune_swap" in _trace_names()
+
+    # rolling the flag back re-keys onto the untouched incumbent
+    FLAGS.cost_calibration = False
+    assert base.plan_signature(build())[0] == key0
+
+
+def test_autotune_closed_loop_via_sample():
+    """Drift anomaly -> tick -> refit -> swap, driven end to end
+    through Monitor.sample() — the chain an operator reads back from
+    st.status()."""
+    FLAGS.monitor_autotune = True
+    FLAGS.monitor_drift_patience = 1
+    FLAGS.monitor_cooldown_s = 0.0
+    FLAGS.calibration_drift_tol = 0.3
+    FLAGS.monitor_swap_margin = 0.05
+    build = _gemm(112, seed=12)
+    v0 = np.asarray(build().glom())
+    _synthetic_rows({"map": 1.0, "contraction": 1.0, "reshard": 1.0,
+                     "psum": 10.0}, seed=8)
+    for _ in range(4):  # sustained service-time mispricing
+        ledger.note_service("drifting-plan", 0.5, 0.1)
+
+    out = monitor.sample()
+    assert any(a.kind == "calibration_drift" for a in out)
+    kinds = [e["event"] for e in _events()]
+    assert "refit" in kinds and "swap" in kinds
+
+    status = st.status()
+    assert status["daemon"]["state"] == "cooldown"
+    assert [e for e in status["daemon"]["events"]
+            if e["event"] == "swap"]
+    assert any(a["kind"] == "calibration_drift"
+               for a in status["anomalies"])
+    np.testing.assert_allclose(v0, np.asarray(build().glom()),
+                               rtol=1e-5)
+
+
+def test_autotune_no_swap_when_model_already_calibrated():
+    """A UNIFORM measured workload (the model is right) must never
+    flap the plans: the fitted factors reprice nothing, the modeled
+    win stays under the margin, the daemon reverts."""
+    FLAGS.monitor_autotune = True
+    FLAGS.monitor_swap_margin = 0.05
+    build = _gemm(80, seed=13)
+    key0 = base.plan_signature(build())[0]
+    build().glom()
+    assert monitor.MONITOR.autotune.templates()
+
+    _synthetic_rows({"map": 1.0, "contraction": 1.0, "reshard": 1.0,
+                     "psum": 1.0}, seed=9)
+    assert monitor.MONITOR.autotune.attempt(0.0) == "revert"
+    assert ledger.active_profile() is None
+    assert FLAGS.cost_calibration is False
+    assert base.plan_signature(build())[0] == key0
+
+
+# -- surfaces -------------------------------------------------------------
+
+
+def test_status_has_monitoring_sections_on_top_of_mesh_contract():
+    FLAGS.serve_slo_classes = "gold=0.5@0.99"
+    st.serve.default_engine()
+    s = st.status()
+    # the long-standing mesh keys stay top-level
+    for k in ("platform", "num_devices", "mesh", "process_index",
+              "memory_stats"):
+        assert k in s
+    assert s["serve"] is not None and "queue_depth" in s["serve"]
+    assert "gold" in s["slo"]
+    assert s["daemon"]["state"] == "idle"
+    assert s["calibration"]["enabled"] is False
+    assert s["monitor"]["running"] is False
+    assert isinstance(s["anomalies"], list)
+
+
+def test_fleet_status_aggregates_ranks_and_skips_corrupt(tmp_path):
+    FLAGS.monitor_fleet_dir = str(tmp_path / "fleet")
+    FLAGS.serve_slo_classes = "gold=0.001@0.9"
+    FLAGS.serve_slo_tenants = "hot=gold"
+    for _ in range(10):
+        slo.observe("hot", 1.0)
+
+    fs = st.fleet_status()
+    assert fs["fleet_dir"] == FLAGS.monitor_fleet_dir
+    assert fs["ranks_reporting"] == 1 and 0 in fs["ranks"]
+    assert fs["slo_worst"]["gold"]["rank"] == 0
+
+    # a peer rank reports a hotter burn; a torn file is skipped
+    peer = {"rank": 1, "wall_t": 0.0,
+            "status": {"slo": {"gold": {"burn_rate": 99.0}},
+                       "anomalies": [{"kind": "slo_burn"}] * 3}}
+    (tmp_path / "fleet" / "rank_1.json").write_text(json.dumps(peer))
+    (tmp_path / "fleet" / "rank_2.json").write_text("{torn")
+    fs = st.fleet_status()
+    assert fs["ranks_reporting"] == 2
+    assert fs["slo_worst"]["gold"] == {"burn_rate": 99.0, "rank": 1}
+    assert fs["anomalies_total"] >= 3
+
+    # without a fleet dir it degrades to the single-rank view
+    FLAGS.monitor_fleet_dir = ""
+    fs = st.fleet_status()
+    assert fs["fleet_dir"] is None and 0 in fs["ranks"]
+
+
+def test_monitor_thread_lifecycle_and_crash_section():
+    FLAGS.monitor = True
+    FLAGS.monitor_interval_s = 0.02
+    monitor.start()
+    try:
+        deadline = 100
+        while (monitor.MONITOR.health()["samples"] == 0
+               and deadline > 0):
+            import time
+
+            time.sleep(0.02)
+            deadline -= 1
+        h = monitor.MONITOR.health()
+        assert h["running"] is True and h["samples"] >= 1
+    finally:
+        monitor.stop()
+    assert monitor.MONITOR.health()["running"] is False
+
+    sec = monitor.crash_section()
+    assert set(sec) == {"health", "anomalies", "daemon",
+                        "series_tail"}
+    assert sec["daemon"]["state"] in ("idle", "cooldown")
+
+
+def test_registry_snapshot_reset_is_atomic_and_keeps_keys():
+    REGISTRY.counter("tmon_ctr", "test counter").inc(5)
+    REGISTRY.gauge("tmon_gauge", "test gauge").set(2.5)
+    REGISTRY.histogram("tmon_hist", "test histogram").observe(1.25)
+    snap = REGISTRY.snapshot(reset=True)
+    assert snap["counters"]["tmon_ctr"] == 5
+    assert snap["gauges"]["tmon_gauge"]["value"] == 2.5
+    assert snap["histograms"]["tmon_hist"]["count"] == 1
+    # the read-and-zero was one critical section: the keys survive,
+    # the values start over
+    snap2 = REGISTRY.snapshot()
+    assert snap2["counters"]["tmon_ctr"] == 0
+    assert snap2["gauges"]["tmon_gauge"]["value"] == 0.0
+    assert snap2["histograms"]["tmon_hist"]["count"] == 0
+    # st.metrics(reset=...) rides the same path
+    m = st.metrics(reset=True)
+    assert "counters" in m
+
+
+# -- serve integration: SLO admission + model-priced shedding -------------
+
+
+def _fresh_expr(seed=21):
+    rng = np.random.RandomState(seed)
+    return (st.as_expr(rng.rand(16, 16).astype(np.float32))
+            + st.as_expr(rng.rand(16, 16).astype(np.float32)))
+
+
+def test_slo_class_queue_share_admission():
+    FLAGS.serve_slo_classes = "bulk=5.0@0.9:0.5"
+    FLAGS.serve_slo_tenants = "b=bulk"
+    engine = st.ServeEngine(workers=1, queue_max=4,
+                            batch_window_s=0.0)
+    # park the engine: submit() auto-starts workers (which would
+    # drain the queue and make depth non-deterministic), so satisfy
+    # its running check with one already-finished placeholder thread.
+    # Submissions then sit in the queue; bulk's share of the 4-deep
+    # queue is 2 slots.
+    import threading
+
+    placeholder = threading.Thread(target=lambda: None)
+    placeholder.start()
+    placeholder.join()
+    engine._threads.append(placeholder)
+    try:
+        engine.submit(_fresh_expr(30), tenant="b")
+        engine.submit(_fresh_expr(31), tenant="b")
+        with pytest.raises(Backpressure):
+            engine.submit(_fresh_expr(32), tenant="b")
+        assert REGISTRY.counter(labeled(
+            "serve_slo_rejected", slo_class="bulk")).value == 1
+        # an untracked tenant still has the full queue available
+        engine.submit(_fresh_expr(33), tenant="other")
+    finally:
+        engine.stop()
+
+
+def test_model_priced_predictive_shed():
+    """A request whose calibrated price exceeds its remaining deadline
+    is shed at pop time WITHOUT burning the dispatch slot — and the
+    rejection names the prediction."""
+    assert FLAGS.serve_model_pricing is True
+    # warm the seconds-per-cost-unit EMA at exactly 1 s/unit
+    ledger.ingest("ema-warm", {"map": 1.0}, 1.0)
+    for _ in range(8):
+        ledger.note_dispatch("ema-warm", "dispatch", 1.0)
+    assert ledger.predict_service_s("ema-warm") == pytest.approx(
+        1.0, rel=1e-6)
+
+    engine = st.ServeEngine(workers=1, queue_max=4)
+    try:
+        doomed = engine_mod._Request(
+            _fresh_expr(40), [], "t", 5.0, mesh_mod.get_mesh())
+        ledger.ingest(key_hash(doomed.plan_key),
+                      {"map": 100.0}, 100.0)  # priced at ~100 s
+        before = REGISTRY.counter("serve_predicted_shed").value
+        live = engine._shed_expired([doomed])
+        assert live == []
+        assert REGISTRY.counter(
+            "serve_predicted_shed").value == before + 1
+        with pytest.raises(DeadlineExceeded, match="predicted"):
+            doomed.future.result(timeout=1)
+
+        # an affordable request under the same deadline sails through
+        ok = engine_mod._Request(
+            _fresh_expr(41), [], "t", 5.0, mesh_mod.get_mesh())
+        ledger.ingest(key_hash(ok.plan_key), {"map": 0.001}, 0.001)
+        assert engine._shed_expired([ok]) == [ok]
+    finally:
+        engine.stop()
+
+
+def test_predictive_shed_requires_model_pricing():
+    FLAGS.serve_model_pricing = False
+    ledger.ingest("ema-warm", {"map": 1.0}, 1.0)
+    for _ in range(8):
+        ledger.note_dispatch("ema-warm", "dispatch", 1.0)
+    engine = st.ServeEngine(workers=1, queue_max=4)
+    try:
+        req = engine_mod._Request(
+            _fresh_expr(42), [], "t", 5.0, mesh_mod.get_mesh())
+        ledger.ingest(key_hash(req.plan_key), {"map": 100.0}, 100.0)
+        # EMA-era behavior: only already-expired deadlines shed
+        assert engine._shed_expired([req]) == [req]
+    finally:
+        engine.stop()

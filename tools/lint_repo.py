@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Fifteen repo-specific rules that generic linters cannot know:
+Sixteen repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -157,6 +157,22 @@ Fifteen repo-specific rules that generic linters cannot know:
     The static-bound forms (``dynamic_slice_in_dim`` on unsharded
     axes, ``lax.slice``) are fine and not flagged.
 
+16. No background-thread construction (``threading.Thread`` /
+    ``threading.Timer``) outside the three sanctioned concurrency
+    seams — ``spartan_tpu/serve/`` (the worker pool),
+    ``spartan_tpu/resilience/`` (recovery drills), and the named
+    daemon files ``obs/monitor.py`` (the sampler),
+    ``obs/numerics.py`` (the dispatch watchdog) and
+    ``persist/__init__.py`` (store prewarm) — the closed-loop
+    telemetry PR: every long-lived thread must be one the monitor's
+    epoch fence, the serve drain barrier and the crash-dump span
+    tree know about. A stray thread elsewhere dodges the mesh-epoch
+    fence (it can dispatch on a dead-device mesh after
+    ``rebuild_mesh``), never appears in ``st.status()``'s health
+    section, and leaks past ``shutdown()``. Locks / Events /
+    Conditions are fine everywhere — the rule is about threads of
+    execution, not synchronization primitives.
+
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings;
 ``--json`` emits the findings as a JSON array for CI tooling) or as a
 module (``python -m tools.lint_repo``) or through the tier-1 suite
@@ -310,6 +326,22 @@ _MUTATION_ATTRS = {"_jax", "_lineage", "_version"}
 _DYNSLICE_ALLOWED_FILES = (
     os.path.join("spartan_tpu", "expr", "incremental.py"),)
 _DYNSLICE_ATTRS = {"dynamic_slice", "dynamic_update_slice"}
+
+# rule 16: the sanctioned concurrency seams — every background thread
+# in the package is one the monitor's epoch fence, the serve drain
+# barrier and the crash-dump span tree account for. Thread/Timer
+# CONSTRUCTION only; Lock/Event/Condition are synchronization, not
+# threads of execution, and are fine everywhere.
+_THREAD_ALLOWED_DIRS = (
+    os.path.join("spartan_tpu", "serve") + os.sep,
+    os.path.join("spartan_tpu", "resilience") + os.sep,
+)
+_THREAD_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "obs", "monitor.py"),
+    os.path.join("spartan_tpu", "obs", "numerics.py"),
+    os.path.join("spartan_tpu", "persist", "__init__.py"),
+}
+_THREAD_CTORS = {"Thread", "Timer"}
 
 
 class Finding:
@@ -920,6 +952,47 @@ def lint_buffer_mutation(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_background_threads(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 16: no ``threading.Thread`` / ``threading.Timer``
+    construction outside the sanctioned concurrency seams (serve/,
+    resilience/, the monitor sampler, the dispatch watchdog, the
+    persist prewarm) — a stray background thread dodges the
+    mesh-epoch fence, is invisible to st.status()'s health section
+    and leaks past shutdown()."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _THREAD_ALLOWED_FILES or any(
+            rel.startswith(d) for d in _THREAD_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            path, getattr(node, "lineno", 0), "background-thread",
+            f"{what}: background threads live in the sanctioned "
+            "concurrency seams (serve/ worker pool, resilience/, "
+            "obs/monitor.py sampler, obs/numerics.py watchdog, "
+            "persist prewarm) where the epoch fence, the drain "
+            "barrier and the crash-dump span tree account for them — "
+            "run the work on an existing seam (serve workers, the "
+            "monitor's tick) instead of spawning a thread"))
+
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _THREAD_CTORS):
+            root = node.func.value
+            if isinstance(root, ast.Name) and root.id == "threading":
+                flag(node, f"threading.{node.func.attr}(...) "
+                     "construction")
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "") == "threading":
+                for a in node.names:
+                    if a.name in _THREAD_CTORS:
+                        flag(node, f"binds threading.{a.name} "
+                             "directly")
+    return findings
+
+
 def _collect_classes(files: List[str]
                      ) -> Dict[str, Tuple[List[str], Set[str], str, int]]:
     """name -> (base names, methods defined in the body, path, line).
@@ -1014,6 +1087,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_persist_seam(path, tree))
         findings.extend(lint_buffer_mutation(path, tree))
         findings.extend(lint_dynamic_slices(path, tree))
+        findings.extend(lint_background_threads(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
