@@ -49,8 +49,13 @@ _LOWER_BETTER = ("seconds", "_ratio", "sec_per_iter", "_s", "_us")
 
 # informational columns with no orientation: byte/count volumes (a
 # bigger migration moved more state, neither better nor worse) — their
-# deltas are reported flat, never as a regression
-_NEUTRAL = ("_bytes", "_arrays", "devices_before", "devices_after")
+# deltas are reported flat, never as a regression. "_samples" /
+# "_shards" / "_plans" cover the skew-observatory evidence counts
+# (how many plans/shards a run happened to sample says nothing about
+# quality); the skew_*_ratio columns stay lower-is-better via the
+# "_ratio" suffix above (less imbalance, less overhead)
+_NEUTRAL = ("_bytes", "_arrays", "devices_before", "devices_after",
+            "_samples", "_shards", "_plans")
 
 
 def _lower_better(name: str) -> bool:
@@ -118,6 +123,14 @@ def _from_run_all(doc: Dict[str, Any]) -> Dict[str, float]:
              ("redistribution_overhead", "redist_off_overhead_ratio")),
             ("profile_off_overhead_ratio",
              ("profile_overhead", "profile_off_overhead_ratio")),
+            ("skew_off_overhead_ratio",
+             ("skew_overhead", "skew_off_overhead_ratio")),
+            ("skew_on_overhead_ratio",
+             ("skew_overhead", "skew_on_overhead_ratio")),
+            ("skew_worst_imbalance_ratio",
+             ("skew_overhead", "skew_worst_imbalance_ratio")),
+            ("skew_sampled_plans",
+             ("skew_overhead", "skew_sampled_plans")),
             ("kernels_off_overhead_ratio",
              ("native_overhead", "kernels_off_overhead_ratio")),
             ("native_kmeans_speedup",

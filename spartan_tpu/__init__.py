@@ -33,7 +33,7 @@ from .ops.stencil import avgpool, maxpool, stencil
 from .analysis import PlanAudit, audit_plan, check, lint
 from . import obs
 from .obs import (AuditReport, CalibrationProfile, DeviceProfile,
-                  ExplainReport, Watchpoint, audit, explain,
+                  ExplainReport, SkewReport, Watchpoint, audit, explain,
                   fit_profile, fleet_status, load_profile, loop_health,
                   metrics, save_profile, status, trace_clear,
                   trace_events, trace_export, unwatch, watch)
@@ -61,6 +61,7 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "ledger", "flightrec", "CalibrationProfile", "fit_profile",
             "save_profile", "load_profile",
             "profile", "profile_export", "DeviceProfile",
+            "skew", "SkewReport",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
             "loop_health",
             "resilience", "chaos", "chaos_clear", "ChaosPlan",
@@ -123,6 +124,18 @@ def profile(expr, tier=None, reps=None):
     one. Continuous sampling in production:
     ``FLAGS.profile_sample_every = N``."""
     return obs.profile.profile(expr, tier=tier, reps=reps)
+
+
+def skew(expr, tier=None, reps=None):
+    """Shard-level skew report (docs/OBSERVABILITY.md): per-device
+    time skew with a collective wait decomposition (time-at-barrier
+    attributed to the plan's psum/all_gather edges via the plan
+    auditor), per-tile data skew over the expression's leaves, and an
+    advisory redistribution-priced re-tiling suggestion when the
+    imbalance ratio exceeds ``FLAGS.skew_warn_ratio`` (report-only).
+    ``tier``/``reps`` forward to the underlying profiler run.
+    Continuous sampling rides ``FLAGS.profile_sample_every``."""
+    return obs.skew.skew(expr, tier=tier, reps=reps)
 
 
 def profile_export(path=None, profile=None):

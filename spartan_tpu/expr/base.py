@@ -177,7 +177,12 @@ class Expr:
             # host callback to this node's value; a no-op None check
             # otherwise, and lower() only runs on plan-cache misses
             numerics_mod.probe(self, val)
-            if self._forced_tiling is not None:
+            if (self._forced_tiling is not None
+                    and not profile_mod.shard_local_lowering()):
+                # (shard-local lowering — the profiler re-timing one
+                # shard's sub-plan per device — must NOT constrain:
+                # the value is shard-sized, and resharding it across
+                # the mesh is exactly what we're measuring around)
                 # smart-tiling chose this node's layout: constrain it
                 # so GSPMD materializes the planned resharding points.
                 # Through the redistribution seam (parallel/

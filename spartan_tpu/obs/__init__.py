@@ -26,6 +26,12 @@ FLAGS-gated cProfile dumps — SURVEY.md §5):
   sampled continuous profiling (``FLAGS.profile_sample_every``) that
   feeds the ledger's device columns, and ``st.profile_export(path)``
   merging host spans + the device timeline into one Perfetto trace.
+* :mod:`skew` — the shard-level skew observatory: ``st.skew(expr)``
+  (per-device time skew with a collective wait decomposition and
+  straggler-edge attribution via the plan auditor, per-tile data
+  skew through the one sanctioned ``addressable_shards`` walk, and
+  an advisory redistribution-priced re-tiling suggestion past
+  ``FLAGS.skew_warn_ratio``), sampled on the profiler's cadence.
 * :mod:`numerics` — the data-health sentinel: ``st.audit(expr)``
   (device-side per-node health words with first-bad-node attribution
   under ``FLAGS.audit_numerics``), ``st.watch(distarray)`` persistent
@@ -55,12 +61,14 @@ from . import metrics as _metrics_mod
 from . import monitor
 from . import numerics
 from . import profile
+from . import skew
 from . import slo
 from . import trace as _trace_mod
 from .explain import ExplainReport, explain
 from .ledger import (CalibrationProfile, fit_profile, load_profile,
                      save_profile)
 from .profile import DeviceProfile
+from .skew import SkewReport
 from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
 from .numerics import (AuditReport, Watchpoint, audit, dump_crash,
                        loop_health, unwatch, watch, watchpoints)
@@ -87,4 +95,5 @@ __all__ = ["span", "Span", "trace_export", "trace_events", "trace_clear",
            "ledger", "ledger_snapshot", "flight", "flightrec",
            "CalibrationProfile", "fit_profile", "save_profile",
            "load_profile", "profile", "DeviceProfile",
+           "skew", "SkewReport",
            "monitor", "slo", "status", "fleet_status"]

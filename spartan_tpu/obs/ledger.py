@@ -127,7 +127,8 @@ class _Entry:
                  "service_total_s", "pred_service_total_s",
                  "device_samples", "device_wall_total_s",
                  "device_attr_total_s", "device_components",
-                 "device_tier")
+                 "device_tier", "skew_samples", "skew_ratio_last",
+                 "skew_ratio_max", "skew_wait_total_s")
 
     def __init__(self, digest: str):
         self.digest = digest
@@ -156,6 +157,14 @@ class _Entry:
         self.device_attr_total_s = 0.0
         self.device_components: Optional[Dict[str, float]] = None
         self.device_tier: Optional[str] = None
+        # SKEW columns (obs/skew.py): shard-imbalance context for the
+        # measurements above — a high ratio means the device rows were
+        # taken while one shard dragged the mesh, so fit_profile can
+        # see (and report) imbalance-inflated calibration input
+        self.skew_samples = 0
+        self.skew_ratio_last: Optional[float] = None
+        self.skew_ratio_max: Optional[float] = None
+        self.skew_wait_total_s = 0.0
 
 
 _lock = threading.Lock()
@@ -319,6 +328,26 @@ def note_device_profile(digest: Optional[str], tier: str,
         e.device_components = comp or None
 
 
+def note_skew(digest: Optional[str], imbalance_ratio: Optional[float],
+              straggler_wait_s: Optional[float]) -> None:
+    """``obs/skew``'s hook: one shard-skew measurement for the plan —
+    the worst per-node max/mean device-seconds ratio and the total
+    barrier wait. Kept next to the device columns so
+    :func:`fit_profile` (and ``st.ledger``) can tell calibration rows
+    measured under a dragging shard from balanced ones."""
+    if not _LEDGER_FLAG._value or digest is None \
+            or imbalance_ratio is None:
+        return
+    with _lock:
+        e = _get_or_create(digest)
+        e.skew_samples += 1
+        e.skew_ratio_last = float(imbalance_ratio)
+        if e.skew_ratio_max is None \
+                or imbalance_ratio > e.skew_ratio_max:
+            e.skew_ratio_max = float(imbalance_ratio)
+        e.skew_wait_total_s += max(0.0, float(straggler_wait_s or 0.0))
+
+
 def ingest(digest: str, components: Dict[str, float],
            measured_s: float, dp_cost: Optional[float] = None) -> None:
     """Offline entry point: feed an externally measured schedule (a
@@ -461,6 +490,13 @@ def snapshot(validate: bool = False) -> Dict[str, Any]:
                         k: round(v / e.device_samples, 9)
                         for k, v in (e.device_components or {}).items()},
                 } if e.device_samples else None),
+                "skew": ({
+                    "samples": e.skew_samples,
+                    "imbalance_ratio_last": round(e.skew_ratio_last, 4),
+                    "imbalance_ratio_max": round(e.skew_ratio_max, 4),
+                    "straggler_wait_mean_s": round(
+                        e.skew_wait_total_s / e.skew_samples, 9),
+                } if e.skew_samples else None),
             },
             "ratios": ratios,
         }
@@ -622,10 +658,18 @@ def fit_profile(min_dispatches: int = 1) -> Optional[CalibrationProfile]:
 
     rows: List[Tuple[Dict[str, float], float]] = []
     device_rows = 0
+    imbalanced_rows = 0
+    warn = float(getattr(FLAGS, "skew_warn_ratio", 1.5) or 1.5)
     with _lock:
         for e in _entries.values():
             if not e.components:
                 continue
+            # skew context: rows fitted from an entry whose last
+            # measured shard-imbalance ratio exceeded the warn
+            # threshold were inflated by a dragging shard — counted
+            # into the profile meta so operators can judge the fit
+            hot = (e.skew_ratio_last is not None
+                   and e.skew_ratio_last > warn)
             if e.device_samples and e.device_components:
                 n = e.device_samples
                 for c, secs in e.device_components.items():
@@ -633,9 +677,11 @@ def fit_profile(min_dispatches: int = 1) -> Optional[CalibrationProfile]:
                     if pc > 0 and secs > 0:
                         rows.append(({c: pc}, secs / n))
                         device_rows += 1
+                        imbalanced_rows += int(hot)
                 continue
             if e.dispatch_min_s and e.dispatch_count >= min_dispatches:
                 rows.append((dict(e.components), e.dispatch_min_s))
+                imbalanced_rows += int(hot)
     if not rows:
         return None
     classes = sorted({c for comp, _ in rows for c in comp
@@ -660,7 +706,8 @@ def fit_profile(min_dispatches: int = 1) -> Optional[CalibrationProfile]:
     return CalibrationProfile(factors_, meta={
         "fitted_from_plans": len(rows), "classes": classes,
         "source": ("device_time" if device_rows else "host_wall"),
-        "device_rows": device_rows})
+        "device_rows": device_rows,
+        "imbalanced_rows": imbalanced_rows})
 
 
 def save_profile(path: str,

@@ -22,8 +22,10 @@ This module closes the loop in three layers:
    fallback-rate spikes (per-interval deltas of the ``persist_*`` /
    ``incremental_*`` / ``redistribute_fallback`` /
    ``serve_solo_fallbacks`` counters past
-   ``FLAGS.monitor_fallback_rate``) and backpressure (queue depth
-   with admission rejections). A breach sustained for
+   ``FLAGS.monitor_fallback_rate``), backpressure (queue depth
+   with admission rejections) and sustained shard imbalance (the
+   skew observatory's last per-plan ratio, ``obs/skew``, past
+   ``FLAGS.skew_warn_ratio``). A breach sustained for
    ``FLAGS.monitor_drift_patience`` consecutive samples emits ONE
    structured :class:`Anomaly` into the trace ring
    (``instant("anomaly")``), the flight record, the
@@ -78,6 +80,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 from ..utils.config import FLAGS
 from . import flight as flight_mod
 from . import ledger as ledger_mod
+from . import skew as skew_mod
 from . import slo as slo_mod
 from . import trace as trace_mod
 from .metrics import METRICS_FLAG as _METRICS_FLAG
@@ -293,6 +296,25 @@ def _burn_observations(burns: Dict[str, Dict[str, Any]]
         obs[name] = (b, thr, b > thr,
                      f"violation rate {rec.get('violation_rate')} over "
                      f"budget {1.0 - rec.get('objective', 0.0):.4g}")
+    return obs
+
+
+def _skew_observations(current: Dict[str, Dict[str, Any]]
+                       ) -> Dict[str, Tuple[float, float, bool, str]]:
+    """Sustained-imbalance detector input: per plan digest, breach
+    when the last measured shard-imbalance ratio (obs/skew) exceeds
+    ``FLAGS.skew_warn_ratio``."""
+    thr = float(getattr(FLAGS, "skew_warn_ratio", 1.5) or 1.5)
+    obs: Dict[str, Tuple[float, float, bool, str]] = {}
+    for digest, rec in current.items():
+        r = rec.get("imbalance_ratio")
+        if r is None:
+            continue
+        obs[digest] = (
+            float(r), thr, r > thr,
+            f"straggler node {rec.get('node')}, hottest shard "
+            f"{rec.get('hottest_shard')}, wait "
+            f"{rec.get('straggler_wait_s')}s")
     return obs
 
 
@@ -526,6 +548,7 @@ class Monitor:
         self.store = TimeSeriesStore()
         self.drift = _SustainedDetector("calibration_drift")
         self.burn = _SustainedDetector("slo_burn")
+        self.imbalance = _SustainedDetector("imbalance")
         self.fallback = _FallbackDetector()
         self.backpressure = _BackpressureDetector()
         self.autotune = _Autotune()
@@ -599,11 +622,17 @@ class Monitor:
                 if hname.startswith(prefix):
                     store.record("p95:" + hname, t, summ.get("p95"))
 
+        skew_cur = skew_mod.current()
+        for digest, rec in skew_cur.items():
+            store.record("skew_imbalance_ratio:" + digest, t,
+                         rec.get("imbalance_ratio"))
+
         anomalies: List[Anomaly] = []
         drift_anoms = self.drift.feed(t, _drift_observations(
             led["models"]))
         anomalies += drift_anoms
         anomalies += self.burn.feed(t, _burn_observations(burns))
+        anomalies += self.imbalance.feed(t, _skew_observations(skew_cur))
         anomalies += self.fallback.observe(t, counters)
         anomalies += self.backpressure.observe(t, depth, rejected)
         for a in anomalies:
@@ -620,6 +649,7 @@ class Monitor:
         self._epoch_seen = epoch
         self.drift.reset()
         self.burn.reset()
+        self.imbalance.reset()
         self.fallback.reset()
         self.backpressure.reset()
         self.autotune.clear_templates()
@@ -673,6 +703,7 @@ class Monitor:
         self.store.clear()
         self.drift.reset()
         self.burn.reset()
+        self.imbalance.reset()
         self.fallback.reset()
         self.backpressure.reset()
         self.autotune.reset()
@@ -783,6 +814,10 @@ def status() -> Dict[str, Any]:
             for m, rec in led["models"].items()
             if rec.get("calibration_error_ratio") is not None},
     }
+    # one-line skew summary (obs/skew): the worst currently-measured
+    # shard-imbalance ratio and the node dragging it, or None when no
+    # skew measurement has been taken
+    s["skew"] = skew_mod.worst_current()
     s["monitor"] = MONITOR.health()
     return s
 
@@ -845,6 +880,7 @@ def fleet_status(dir_path: Optional[str] = None) -> Dict[str, Any]:
             continue  # torn/corrupt file: skip, never fail the merge
 
     slo_worst: Dict[str, Dict[str, Any]] = {}
+    skew_worst: Optional[Dict[str, Any]] = None
     anomaly_count = 0
     for doc in ranks.values():
         st_doc = doc.get("status") or {}
@@ -857,6 +893,14 @@ def fleet_status(dir_path: Optional[str] = None) -> Dict[str, Any]:
                     or b > cur["burn_rate"]):
                 slo_worst[cls] = {"burn_rate": b,
                                   "rank": doc.get("rank")}
+        # worst shard-imbalance across ranks (the straggler is a
+        # fleet-level property: one rank's hot shard taxes every rank
+        # at the next collective)
+        sk = st_doc.get("skew")
+        if sk and sk.get("ratio") is not None and (
+                skew_worst is None or sk["ratio"] > skew_worst["ratio"]):
+            skew_worst = dict(sk)
+            skew_worst["rank"] = doc.get("rank")
     from ..parallel import mesh as mesh_mod  # lazy: layer order
 
     return {
@@ -864,6 +908,7 @@ def fleet_status(dir_path: Optional[str] = None) -> Dict[str, Any]:
         "process_count": mesh_mod.status().get("process_count"),
         "ranks_reporting": len(ranks),
         "slo_worst": slo_worst,
+        "skew_worst": skew_worst,
         "anomalies_total": anomaly_count,
         "ranks": ranks,
     }

@@ -542,28 +542,14 @@ def array_health(arr: Any) -> Dict[str, Any]:
 
 def tile_stats(arr: Any) -> List[Dict[str, Any]]:
     """Per-tile (per device shard) health stats, host-computed from
-    the addressable shards."""
-    import jax
+    the addressable shards. The walk itself lives in
+    ``obs/skew.per_shard_stats`` — the one sanctioned raw
+    ``addressable_shards`` iteration outside the array layer (lint
+    rule 17), shared with the data-skew sampler; the records here
+    additionally carry ``nbytes``/``nnz``."""
+    from . import skew as skew_mod  # lazy: skew imports obs.profile
 
-    arr = _as_array(arr)
-    out = []
-    for sh in arr.jax_array.addressable_shards:
-        d = np.asarray(jax.device_get(sh.data))
-        df = d.astype(np.float64) if d.dtype.kind in "biu" else d
-        if d.size == 0:
-            out.append({"device": str(sh.device), "index": str(sh.index),
-                        "nan_count": 0, "inf_count": 0, "absmax": 0.0,
-                        "zero_frac": 0.0, "size": 0})
-            continue
-        out.append({
-            "device": str(sh.device), "index": str(sh.index),
-            "nan_count": int(np.isnan(df).sum()),
-            "inf_count": int(np.isinf(df).sum()),
-            "absmax": float(np.max(np.abs(df))),
-            "zero_frac": float(np.mean(df == 0)),
-            "size": int(d.size),
-        })
-    return out
+    return skew_mod.per_shard_stats(arr)
 
 
 class Watchpoint:

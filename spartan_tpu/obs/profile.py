@@ -215,9 +215,12 @@ class DeviceProfile:
 
     ``nodes`` is a list of dicts sorted hottest-first, each carrying
     ``node`` (label), ``digest``, ``op_class``, ``site``, ``shape``,
-    ``seconds`` (measured device time), ``share`` (of attributed) and
+    ``seconds`` (measured device time), ``share`` (of attributed),
     ``modeled_cost`` (the tiling DP's estimate for the same node —
-    measured next to modeled, per node)."""
+    measured next to modeled, per node) and, when the tier resolved
+    them, ``device_seconds`` ({device label: seconds} — the xplane
+    tier's per-track split / the replay tier's shard-local re-times;
+    ``obs.skew`` turns these into imbalance ratios)."""
 
     def __init__(self, tier: str, plan_digest: Optional[str],
                  wall_s: float, nodes: List[Dict[str, Any]],
@@ -504,10 +507,110 @@ def _replay_times(attr: _Attribution, args: List[Any], reps: int
     return inc, t_root, skipped
 
 
-def _parse_trace_dir(root_dir: str) -> Optional[Dict[str, float]]:
-    """Sum device-event durations per ``__sg_`` digest across every
-    trace-event JSON the capture wrote. None when nothing parsable
-    (or nothing digest-tagged) was found."""
+# -- shard-local replay (per-device seconds for the skew observatory) -----
+
+
+class shard_local_session:
+    """Marks this thread's lowering as SHARD-LOCAL: ``Expr.lower``
+    skips the smart-tiling ``with_sharding_constraint`` (which would
+    reshard a shard-sized value back across the whole mesh, or fail
+    on the shard's shape) so a node's sub-plan can be re-traced on a
+    single shard's buffers and timed per device. Trace-time only."""
+
+    __slots__ = ("_prev",)
+
+    def __enter__(self) -> "shard_local_session":
+        self._prev = getattr(_tls, "shard_local", False)
+        _tls.shard_local = True
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.shard_local = self._prev
+
+
+def shard_local_lowering() -> bool:
+    """True while this thread traces under a shard-local session
+    (checked by ``Expr.lower``'s constrain branch — trace time only,
+    never on the dispatch path)."""
+    return bool(getattr(_tls, "shard_local", False))
+
+
+# replay-tier per-device budget: each timed node costs one jit trace +
+# reps dispatches PER DEVICE, so only the hottest few (plus the root)
+# get the shard-local treatment
+_SKEW_NODE_BUDGET = 4
+
+
+def _replay_device_times(attr: _Attribution, node_ids: List[int],
+                         args: List[Any], reps: int
+                         ) -> Dict[int, Dict[str, float]]:
+    """Per-device seconds for the given (hottest) nodes via
+    shard-local dispatch: each leaf argument is cut to the shard
+    living on one device (``obs.skew.local_shards`` — the sanctioned
+    walk, lint rule 17) and the node's sub-plan re-traced under the
+    shard-local session on that device alone. The spread across
+    devices is the time-skew signal; a node whose shard-local trace
+    cannot stand alone (shape-dependent op, explicit-collective
+    shuffle) is simply skipped — the skew report is advisory."""
+    import jax
+
+    from . import skew as skew_mod
+
+    sharded = [a for a in args if hasattr(a, "addressable_shards")]
+    if not sharded:
+        return {}
+    try:
+        devices = [d for d, _ in skew_mod.local_shards(sharded[0])]
+    except Exception:  # noqa: BLE001 - deleted/donated buffers
+        return {}
+    if len(devices) < 2:
+        return {}
+    per_dev: Dict[Any, List[Any]] = {d: [] for d in devices}
+    for a in args:
+        if hasattr(a, "addressable_shards"):
+            try:
+                by_dev = dict(skew_mod.local_shards(a))
+            except Exception:  # noqa: BLE001
+                return {}
+            if any(d not in by_dev for d in devices):
+                return {}  # uneven placement: no clean per-device cut
+            for d in devices:
+                per_dev[d].append(by_dev[d])
+        else:
+            for d in devices:
+                per_dev[d].append(jax.device_put(a, d))
+    out: Dict[int, Dict[str, float]] = {}
+    by_id = {n._id: n for n in attr.nodes}
+    leaf_ids = attr.leaf_ids
+    naming = attr.naming
+    for nid in node_ids:
+        node = by_id.get(nid)
+        if node is None:
+            continue
+
+        def fn(*a: Any, _node: Any = node) -> Any:
+            env = dict(zip(leaf_ids, a))
+            with _use_naming(naming), shard_local_session():
+                return _node.lower(env)
+
+        jf = jax.jit(fn)
+        dev_secs: Dict[str, float] = {}
+        try:
+            for d in devices:
+                dev_secs[str(d)] = _time_call(jf, per_dev[d], reps)
+        except Exception:  # noqa: BLE001 - not shard-locally traceable
+            continue
+        out[nid] = dev_secs
+    return out
+
+
+def _parse_trace_dir(root_dir: str) -> Tuple[
+        Optional[Dict[str, float]], Dict[str, Dict[str, float]]]:
+    """Fold device-event durations per ``__sg_`` digest across every
+    trace-event JSON the capture wrote: the per-digest totals, plus
+    the per-device-TRACK breakdown (digest -> {device label: seconds})
+    the skew observatory attributes stragglers from. ``(None, {})``
+    when nothing parsable (or nothing digest-tagged) was found."""
     events: List[Dict[str, Any]] = []
     for dirpath, _dirs, files in os.walk(root_dir):
         for f in files:
@@ -525,18 +628,23 @@ def _parse_trace_dir(root_dir: str) -> Optional[Dict[str, float]]:
                 continue
             events.extend(doc.get("traceEvents") or [])
     if not events:
-        return None
+        return None, {}
     # device tracks: process_name metadata naming a device stream;
     # when the runtime labels nothing, fall back to every track (the
-    # auto tier's coverage check rejects a garbage parse)
+    # auto tier's coverage check rejects a garbage parse). The pid IS
+    # the device identity in XPlane exports (one process row per
+    # chip), so the name doubles as the skew report's device label.
     device_pids = set()
+    pid_names: Dict[Any, str] = {}
     for ev in events:
         if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            name = str((ev.get("args") or {}).get("name", "")).lower()
-            if any(k in name for k in ("/device:", "tpu", "gpu",
-                                       "stream", "xla")):
+            name = str((ev.get("args") or {}).get("name", ""))
+            if any(k in name.lower() for k in ("/device:", "tpu", "gpu",
+                                               "stream", "xla")):
                 device_pids.add(ev.get("pid"))
+            pid_names[ev.get("pid")] = name
     out: Dict[str, float] = {}
+    out_dev: Dict[str, Dict[str, float]] = {}
     for ev in events:
         if ev.get("ph") != "X":
             continue
@@ -548,17 +656,24 @@ def _parse_trace_dir(root_dir: str) -> Optional[Dict[str, float]]:
             m = _SCOPE_RX.search(json.dumps(ev["args"]))
         if m is None:
             continue
-        out[m.group(1)] = out.get(m.group(1), 0.0) \
-            + float(ev.get("dur", 0.0)) / 1e6
-    return out or None
+        secs = float(ev.get("dur", 0.0)) / 1e6
+        dg = m.group(1)
+        out[dg] = out.get(dg, 0.0) + secs
+        dev = pid_names.get(ev.get("pid")) or f"pid{ev.get('pid')}"
+        slot = out_dev.setdefault(dg, {})
+        slot[dev] = slot.get(dev, 0.0) + secs
+    return (out or None), out_dev
 
 
 def _xplane_times(attr: _Attribution, args: List[Any]
-                  ) -> Optional[Dict[int, float]]:
+                  ) -> Optional[Tuple[Dict[int, float],
+                                      Dict[int, Dict[str, float]]]]:
     """Capture one whole-plan run under ``obs.trace.device_profile``
     and attribute per-node seconds from the digest-tagged device
-    events. None when the capture is busy, fails, or yields nothing
-    joinable (the auto tier then falls back to replay)."""
+    events — totals plus the per-device-track breakdown (node id ->
+    {device label: seconds}, the skew observatory's input). None when
+    the capture is busy, fails, or yields nothing joinable (the auto
+    tier then falls back to replay)."""
     if not _capture_lock.acquire(blocking=False):
         return None
     tmp = tempfile.mkdtemp(prefix="spartan_tpu_xplane_")
@@ -570,15 +685,18 @@ def _xplane_times(attr: _Attribution, args: List[Any]
                 _run_blocked(fn, args)
         except Exception:  # noqa: BLE001 - capture is best-effort
             return None
-        by_digest = _parse_trace_dir(tmp)
+        by_digest, by_dev = _parse_trace_dir(tmp)
         if not by_digest:
             return None
         out: Dict[int, float] = {}
+        out_dev: Dict[int, Dict[str, float]] = {}
         for n in attr.nodes:
             d = attr.meta[n._id]["digest"]
             if d is not None and d in by_digest:
                 out[n._id] = by_digest[d]
-        return out or None
+                if len(by_dev.get(d) or ()) > 1:
+                    out_dev[n._id] = dict(by_dev[d])
+        return (out, out_dev) if out else None
     finally:
         _capture_lock.release()
         shutil.rmtree(tmp, ignore_errors=True)
@@ -589,14 +707,17 @@ def _profile_impl(attr: _Attribution, args: List[Any], wall_s: float,
                   digest: Optional[str]) -> DeviceProfile:
     chosen = tier
     node_secs: Optional[Dict[int, float]] = None
+    node_dev: Dict[int, Dict[str, float]] = {}
     skipped = 0
     if tier in ("auto", "xplane"):
-        node_secs = _xplane_times(attr, args)
-        if node_secs is not None:
+        cap = _xplane_times(attr, args)
+        if cap is not None:
+            node_secs, node_dev = cap
             chosen = "xplane"
             att = sum(node_secs.values())
             if tier == "auto" and (wall_s <= 0 or att < 0.5 * wall_s):
                 node_secs = None  # partial capture: replay is exact
+                node_dev = {}
     if node_secs is None:
         if tier == "xplane":
             raise RuntimeError(
@@ -611,6 +732,16 @@ def _profile_impl(attr: _Attribution, args: List[Any], wall_s: float,
         # the smaller is the better device-wall estimate (a sampled
         # dispatch's host wall also includes launch overhead)
         wall_s = min(wall_s, t_root) if wall_s > 0 else t_root
+        # per-device seconds (the skew observatory): the xplane tier
+        # reads them off the capture's device tracks for free; here
+        # the hottest few nodes + the root earn a shard-local re-time
+        hot = sorted((nid for nid, s in node_secs.items() if s > 0),
+                     key=lambda nid: -node_secs[nid])
+        want = hot[:_SKEW_NODE_BUDGET]
+        if attr.dag._id in node_secs and attr.dag._id not in want:
+            want.append(attr.dag._id)
+        if want:
+            node_dev = _replay_device_times(attr, want, args, reps)
     nodes: List[Dict[str, Any]] = []
     total = sum(node_secs.values()) or 1.0
     for nid, secs in node_secs.items():
@@ -619,6 +750,10 @@ def _profile_impl(attr: _Attribution, args: List[Any], wall_s: float,
         rec = dict(attr.meta[nid])
         rec["seconds"] = round(secs, 9)
         rec["share"] = round(secs / total, 4)
+        dev = node_dev.get(nid)
+        if dev:
+            rec["device_seconds"] = {d: round(s, 9)
+                                     for d, s in dev.items()}
         nodes.append(rec)
     return DeviceProfile(chosen, digest, wall_s, nodes,
                          nodes_skipped=skipped)
@@ -774,6 +909,12 @@ def maybe_sample(expr: Any, plan: Any, phase_name: str, seconds: float,
             prof = _profile_impl(attr, args, wall_s=seconds, tier=tier,
                                  reps=1, digest=digest)
         _record(prof, plan)
+        # the skew observatory rides the same cadence: per-device
+        # timeline + bounded data-skew walk, still off the result
+        # path (lazy import: skew binds this module at its top)
+        from . import skew as skew_mod
+
+        skew_mod.note_sampled(prof, plan, leaves)
         # the serve worker stamps the request's flight record from
         # this thread-local (the sample ran on the worker's thread)
         _tls.last_sample = {

@@ -48,6 +48,7 @@ from ..obs import flight as flight_mod
 from ..obs import ledger as ledger_mod
 from ..obs import numerics as numerics_mod
 from ..obs import profile as profile_mod
+from ..obs import skew as skew_mod
 from ..obs import trace as trace_mod
 from ..obs.explain import key_hash
 from ..obs import slo as slo_mod
@@ -507,6 +508,11 @@ class ServeEngine:
                 samp = profile_mod.take_last_sample()
                 if samp is not None:
                     flight_mod.note(req.rid, "profiled", **samp)
+                    # the skew observatory rode the same sample: its
+                    # per-shard summary lands as its own event
+                    sk = skew_mod.take_last_sample()
+                    if sk is not None:
+                        flight_mod.note(req.rid, "skew", **sk)
 
     def _predict_service_s(self, r: "_Request") -> float:
         """This request's service-time prediction: the calibrated

@@ -281,6 +281,12 @@ FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
 #   monitor_fleet_dir    (obs/monitor.py, default "") — rank-snapshot
 #       directory behind st.fleet_status() (atomic per-rank files,
 #       rank-0 merge).
+#   skew_warn_ratio      (obs/skew.py, default 1.5) — shard-imbalance
+#       ratio (hottest shard / mesh mean, per node) above which
+#       st.skew prints the advisory re-tiling suggestion and the
+#       monitor's sustained-imbalance detector counts a breach; the
+#       skew observatory itself rides profile_sample_every
+#       (benchmarks/skew_overhead.py <=1% off-path gate).
 #   serve_model_pricing  (serve/engine.py, default True) — price
 #       deadline shedding + the ledger's service rows with the
 #       calibrated cost model instead of the raw queue EMA (falls

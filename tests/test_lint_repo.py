@@ -605,3 +605,33 @@ def test_background_threads_allowed_in_seams():
     other = os.path.join(lint_repo.REPO, "spartan_tpu", "obs",
                          "trace.py")
     assert lint_repo.lint_background_threads(other, tree) != []
+
+
+def test_catches_raw_shard_walks(tmp_path):
+    bad = tmp_path / "walk_mod.py"
+    bad.write_text(
+        "def tile_bytes(jarr):\n"
+        "    return [s.data.nbytes for s in jarr.addressable_shards]\n"
+        "n = len(x.jax_array.addressable_shards)\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_shard_walks(str(bad), tree)
+    assert sum(f.rule == "shard-walk" for f in findings) == 2
+    # ... and the sanctioned seam is named in the remedy
+    assert all("per_shard_stats" in f.message for f in findings)
+
+
+def test_shard_walks_allowed_in_owners():
+    tree = ast.parse("def f(jarr):\n"
+                     "    return list(jarr.addressable_shards)\n")
+    for rel in (os.path.join("spartan_tpu", "obs", "skew.py"),
+                os.path.join("spartan_tpu", "utils", "checkpoint.py"),
+                os.path.join("spartan_tpu", "array", "distarray.py"),
+                os.path.join("spartan_tpu", "array", "sparse.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_shard_walks(path, tree) == []
+    # the same walk anywhere else in obs (or the expr layer) is a
+    # finding: per-tile reads single-source through obs/skew.py
+    for rel in (os.path.join("spartan_tpu", "obs", "numerics.py"),
+                os.path.join("spartan_tpu", "expr", "base.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_shard_walks(path, tree) != []

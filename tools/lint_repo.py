@@ -343,6 +343,19 @@ _THREAD_ALLOWED_FILES = {
 }
 _THREAD_CTORS = {"Thread", "Timer"}
 
+# rule 17: raw ``addressable_shards`` iteration is the shard-walk
+# seam — every per-tile read-out must agree on device labels, index
+# formatting and host-fetch behavior, or the skew observatory's
+# imbalance attribution, numerics tile-health and checkpointing
+# disagree about which shard is which. One sanctioned walk
+# (obs/skew.local_shards / per_shard_stats), the array layer that
+# owns the buffers, and the checkpoint serialization seam.
+_SHARDS_ALLOWED_DIRS = (os.path.join("spartan_tpu", "array") + os.sep,)
+_SHARDS_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "obs", "skew.py"),
+    os.path.join("spartan_tpu", "utils", "checkpoint.py"),
+}
+
 
 class Finding:
     def __init__(self, path: str, line: int, rule: str, message: str):
@@ -701,6 +714,34 @@ def lint_dynamic_slices(path: str, tree: ast.AST) -> List[Finding]:
                 "(docs/INCREMENTAL.md); use static-bound slicing "
                 "(lax.slice / dynamic_slice_in_dim on unsharded "
                 "axes) or the incremental API instead"))
+    return findings
+
+
+def lint_shard_walks(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 17: no raw ``addressable_shards`` access outside the
+    shard-walk seam (obs/skew.py), the array layer and the checkpoint
+    serializer — per-tile read-outs that bypass
+    ``obs.skew.per_shard_stats`` / ``local_shards`` drift on device
+    labels and fetch behavior, and the skew observatory's straggler
+    attribution stops matching what the other surfaces report."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _SHARDS_ALLOWED_FILES or any(
+            rel.startswith(d) for d in _SHARDS_ALLOWED_DIRS):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr == "addressable_shards"):
+            findings.append(Finding(
+                path, node.lineno, "shard-walk",
+                "raw .addressable_shards access outside the shard-walk "
+                "seam: per-tile reads are single-sourced through "
+                "obs.skew.per_shard_stats(arr) / local_shards(jarr) "
+                "(plus the array layer and utils/checkpoint.py's "
+                "serializer) so device labels, shard indices and "
+                "host-fetch behavior agree across the skew "
+                "observatory, tile health and checkpoints — use those "
+                "helpers instead (docs/OBSERVABILITY.md)"))
     return findings
 
 
@@ -1088,6 +1129,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_buffer_mutation(path, tree))
         findings.extend(lint_dynamic_slices(path, tree))
         findings.extend(lint_background_threads(path, tree))
+        findings.extend(lint_shard_walks(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
