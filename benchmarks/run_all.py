@@ -390,6 +390,24 @@ def skew_overhead(st):
     return sk.measure(iters=64, n=4096)
 
 
+def integrity_overhead(st):
+    """SDC-sentinel gates (benchmarks/integrity_overhead.py): the
+    integrity layer's off-path toll on the steady-state hit path
+    (<=1% is the ISSUE-20 gate; with FLAGS.integrity_check off the
+    sentinel is ONE flag read per dispatch — Q1 paired-block
+    estimator vs a null-shim build, cpu AND tpu) plus the checks-on
+    ratio, reported unjudged (a screened dispatch pays its checksum
+    walk + rotated redundant re-execution by design), with the
+    sentinel's check/violation counters riding the record as
+    evidence."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import integrity_overhead as ig
+
+    if SMALL:
+        return ig.measure(iters=32, n=512)
+    return ig.measure(iters=64, n=4096)
+
+
 def monitor_overhead(st):
     """Continuous-monitor gates (benchmarks/monitor_overhead.py): the
     closed-loop telemetry layer's toll on the serve hot path with
@@ -478,6 +496,9 @@ def guard_metrics(report) -> dict:
         "skew_off_overhead_ratio":
             report["skew_overhead"].get(
                 "skew_off_overhead_ratio"),
+        "integrity_off_overhead_ratio":
+            report["integrity_overhead"].get(
+                "integrity_off_overhead_ratio"),
         "elastic_off_overhead_ratio":
             report["elastic_overhead"].get(
                 "elastic_off_overhead_ratio"),
@@ -562,6 +583,7 @@ def main():
         "serving_overhead": _with_metrics(serving_overhead, st),
         "monitor_overhead": _with_metrics(monitor_overhead, st),
         "skew_overhead": _with_metrics(skew_overhead, st),
+        "integrity_overhead": _with_metrics(integrity_overhead, st),
         "elastic_overhead": _with_metrics(elastic_overhead, st),
         "memgov_overhead": _with_metrics(memgov_overhead, st),
         "calibration_overhead": _with_metrics(calibration_overhead, st),
@@ -608,6 +630,7 @@ def main():
                  "serve_off_overhead_ratio": 0.02,
                  "monitor_off_overhead_ratio": 0.01,
                  "skew_off_overhead_ratio": 0.01,
+                 "integrity_off_overhead_ratio": 0.01,
                  "elastic_off_overhead_ratio": 0.01,
                  "memgov_off_overhead_ratio": 0.01,
                  "calibration_off_overhead_ratio": 0.01,

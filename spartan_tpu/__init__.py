@@ -38,7 +38,8 @@ from .obs import (AuditReport, CalibrationProfile, DeviceProfile,
                   metrics, save_profile, status, trace_clear,
                   trace_events, trace_export, unwatch, watch)
 from . import resilience
-from .resilience import ChaosPlan, FatalMeshError, chaos, chaos_clear
+from .resilience import (ChaosPlan, FatalMeshError, IntegrityError,
+                         chaos, chaos_clear)
 from . import serve
 from .serve import (Backpressure, DeadlineExceeded, EvalFuture,
                     MeshReconfiguring, ServeEngine, evaluate_async)
@@ -65,7 +66,7 @@ __all__ = (["DistArray", "SparseDistArray", "MaskedDistArray", "TileExtent",
             "audit", "AuditReport", "watch", "unwatch", "Watchpoint",
             "loop_health",
             "resilience", "chaos", "chaos_clear", "ChaosPlan",
-            "FatalMeshError",
+            "FatalMeshError", "IntegrityError",
             "serve", "ServeEngine", "EvalFuture", "evaluate_async",
             "Backpressure", "DeadlineExceeded", "MeshReconfiguring"]
            + list(_expr_all))

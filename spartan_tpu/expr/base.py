@@ -40,6 +40,7 @@ from ..parallel import redistribute as redistribute_mod
 from .. import persist as persist_mod
 from ..resilience import degrade as degrade_mod
 from ..resilience import faults as faults_mod
+from ..resilience import integrity as integrity_mod
 from ..resilience import memory as memory_mod
 from ..utils import config as config_mod
 from ..utils import profiling as prof
@@ -1290,6 +1291,14 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
             out = run()
         if dpos:
             dsp.set(donated=sorted(dpos))
+    if faults_mod._ACTIVE is not None:
+        # chaos `sdc` seam: a matching token armed a silent corruption
+        # at fire() above; apply the seeded bit-flip to the result the
+        # device "computed". Nothing raises here — detection is the
+        # integrity sentinel's job below (or nobody's, when the check
+        # is off: that IS the threat model). One attribute read when
+        # no plan is installed.
+        out = faults_mod.corrupt_output(out)
     ex.warm = True
     if fresh and not dpos and plan.persist_digest is not None:
         # first compile of a persistable plan: serialize + store it
@@ -1310,6 +1319,15 @@ def _dispatch(expr: Expr, plan: _Plan, leaves: List[Expr],
         # profiling entry point, one flag read per dispatch when off.
         profile_mod.maybe_sample(expr, plan, phase_name,
                                  phase_ctx.seconds, leaves, dpos, mesh)
+
+    if integrity_mod._CHECK_FLAG._value:
+        # SDC sentinel (resilience/integrity.py): every Nth run of a
+        # plan gets a per-shard checksum + redundant re-execution on a
+        # rotated device assignment. Raises IntegrityError (class
+        # 'sdc') on disagreement — the corrupt `out` is never wrapped,
+        # cached, or returned. One flag read when off.
+        integrity_mod.maybe_check(expr, plan, phase_name, out, args,
+                                  dpos, mesh)
 
     if FLAGS.check_determinism and not dpos:  # a donated arg is gone
         out2 = run()
@@ -1589,12 +1607,22 @@ def _build_plan(expr: Expr, mesh, rctx: Optional[_PlanSigCtx],
             else:
                 out = dag.lower(env)
         # a constraint (not jit out_shardings) so GSPMD propagation can
-        # negotiate ops like reverse that hard-fail on output overrides
+        # negotiate ops like reverse that hard-fail on output overrides.
+        # Resolved against the ambient mesh at TRACE time: a retrace
+        # under a same-shape substitute assignment (integrity's rotated
+        # redundant execution pins one via use_mesh) must bind its
+        # constraints to that assignment — XLA rejects programs mixing
+        # two device orders. Normal dispatch traces under the build
+        # mesh, where this is exactly the prebuilt tuple.
+        osh = out_shardings
+        amb = mesh_mod.get_mesh()
+        if amb is not mesh:
+            osh = tuple(t.sharding(amb) for t in out_tilings)
         if is_tuple:
             return tuple(
                 jax.lax.with_sharding_constraint(o, s)
-                for o, s in zip(out, out_shardings))
-        return jax.lax.with_sharding_constraint(out, out_shardings[0])
+                for o, s in zip(out, osh))
+        return jax.lax.with_sharding_constraint(out, osh[0])
 
     identity = tuple(range(len(leaves)))
     raw_order: Optional[Tuple[int, ...]] = None

@@ -531,6 +531,20 @@ class ExplainReport:
                     f"    ADVISORY: re-tile {adv['src']} -> "
                     f"{adv['dst']} ~cost {adv['modeled_cost']} "
                     f"via {adv['schedule']} (report-only)")
+        integ = d.get("integrity")
+        if integ:
+            # SDC sentinel verdict (resilience/integrity.py): the last
+            # sampled checksum cross-check of this plan
+            line = (f"  integrity [{integ.get('verdict')}]: check "
+                    f"#{integ.get('check')}, rotation "
+                    f"+{integ.get('rotation')}")
+            if integ.get("verdict") != "ok":
+                line += (f", {integ.get('shards')} shard(s) disagree, "
+                         f"suspects {integ.get('suspects')}")
+                if integ.get("quarantined") is not None:
+                    line += (f" — device {integ['quarantined']} "
+                             "QUARANTINED")
+            lines.append(line)
         if d.get("leaves") is not None:
             lines.append(f"  leaves: {len(d['leaves'])} "
                          f"(arg order {d.get('arg_order')})")

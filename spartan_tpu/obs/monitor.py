@@ -778,6 +778,18 @@ def recent_anomalies(limit: int = 16) -> List[Dict[str, Any]]:
     return [a.to_dict() for a in list(MONITOR.anomalies)[-limit:]]
 
 
+def note_anomaly(kind: str, key: str, value: float, threshold: float,
+                 detail: str = "") -> None:
+    """Emit a structured anomaly from OUTSIDE the monitor's detectors
+    (same ring, trace instant, flight note, and per-kind counter as a
+    detector finding). The integrity sentinel uses this to raise its
+    ``sdc`` anomaly when a suspect device crosses the quarantine
+    threshold — the monitor need not be started for the anomaly to be
+    recorded."""
+    MONITOR._emit(Anomaly(kind, key, trace_mod.now(), float(value),
+                          float(threshold), detail))
+
+
 def crash_section() -> Dict[str, Any]:
     """The monitor's contribution to ``dump_crash`` (advisory)."""
     return {
@@ -818,6 +830,12 @@ def status() -> Dict[str, Any]:
     # shard-imbalance ratio and the node dragging it, or None when no
     # skew measurement has been taken
     s["skew"] = skew_mod.worst_current()
+    # integrity line (resilience/integrity.py, lazy: layer order):
+    # checks run, violations, in-window strikes per device, quarantine
+    # history — None until the SDC sentinel has run at least once
+    from ..resilience import integrity as integrity_mod
+
+    s["integrity"] = integrity_mod.status()
     s["monitor"] = MONITOR.health()
     return s
 
@@ -882,9 +900,24 @@ def fleet_status(dir_path: Optional[str] = None) -> Dict[str, Any]:
     slo_worst: Dict[str, Dict[str, Any]] = {}
     skew_worst: Optional[Dict[str, Any]] = None
     anomaly_count = 0
+    integ: Dict[str, Any] = {"checks": 0, "violations": 0,
+                             "quarantined": []}
+    integ_seen = False
     for doc in ranks.values():
         st_doc = doc.get("status") or {}
         anomaly_count += len(st_doc.get("anomalies") or ())
+        # fleet integrity roll-up: totals across ranks plus every
+        # rank's quarantine history (a quarantined chip is a
+        # fleet-level casualty: the mesh every rank shares shrank)
+        it = st_doc.get("integrity")
+        if it:
+            integ_seen = True
+            integ["checks"] += int(it.get("checks") or 0)
+            integ["violations"] += int(it.get("violations") or 0)
+            for rec in it.get("quarantined") or ():
+                q = dict(rec)
+                q["rank"] = doc.get("rank")
+                integ["quarantined"].append(q)
         for cls, rec in (st_doc.get("slo") or {}).items():
             b = rec.get("burn_rate")
             cur = slo_worst.get(cls)
@@ -909,6 +942,7 @@ def fleet_status(dir_path: Optional[str] = None) -> Dict[str, Any]:
         "ranks_reporting": len(ranks),
         "slo_worst": slo_worst,
         "skew_worst": skew_worst,
+        "integrity": integ if integ_seen else None,
         "anomalies_total": anomaly_count,
         "ranks": ranks,
     }

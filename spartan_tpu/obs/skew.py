@@ -83,6 +83,15 @@ def local_shards(jarr: Any) -> List[Tuple[Any, Any]]:
     return [(sh.device, sh.data) for sh in jarr.addressable_shards]
 
 
+def local_shards_indexed(jarr: Any) -> List[Tuple[Any, Any, Any]]:
+    """:func:`local_shards` plus each shard's global index (the tuple
+    of slices placing it in the full array) — the integrity sentinel
+    compares checksums of the SAME logical shard across two device
+    assignments, so it needs position, not just residence."""
+    return [(sh.device, sh.index, sh.data)
+            for sh in jarr.addressable_shards]
+
+
 def per_shard_stats(arr: Any) -> List[Dict[str, Any]]:
     """Per-tile (per device shard) stats, host-computed from the
     addressable shards — the walk ``obs/numerics.tile_stats`` used to

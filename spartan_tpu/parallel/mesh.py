@@ -237,6 +237,31 @@ def device_count(mesh: Optional[Mesh] = None) -> int:
     return int(np.prod(list(mesh.shape.values())))
 
 
+def rotated_mesh(mesh: Optional[Mesh] = None, k: int = 1
+                 ) -> Optional[Mesh]:
+    """A mesh with the SAME shape and axis names but the device
+    assignment rotated by ``k`` positions — every logical coordinate
+    maps to a different physical chip. The integrity sentinel
+    (resilience/integrity.py) re-executes sampled plans on a rotated
+    assignment so a per-shard checksum disagreement separates "this
+    chip computes wrong bits" from "this value is wrong wherever it is
+    computed". Returns None for a single-device mesh (no rotation
+    exists). Never installed or cached: callers build one per check
+    and drop it (the epoch/staleness machinery only governs the one
+    global mesh)."""
+    mesh = mesh or get_mesh()
+    devs = list(mesh.devices.flat)
+    n = len(devs)
+    if n < 2:
+        return None
+    k = k % n
+    if k == 0:
+        k = 1
+    rot = devs[k:] + devs[:k]
+    return Mesh(np.array(rot).reshape(mesh.devices.shape),
+                mesh.axis_names)
+
+
 _dist_initialized = False
 _dist_lock = threading.Lock()
 

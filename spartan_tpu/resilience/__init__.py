@@ -42,6 +42,13 @@ package makes every failure a tested, observable code path:
   fences every plan key and DistArray), evict the dead epoch's
   plans, and let checkpointed loops resume from their snapshots on
   the shrunken mesh.
+* :mod:`integrity` — the silent-data-corruption sentinel
+  (``FLAGS.integrity_check``): sampled per-shard checksums +
+  redundant re-execution on a rotated device assignment; a
+  disagreement discards the result (class ``sdc``, retried), repeat
+  offenders are quarantined via a planned ``rebuild_mesh`` exclusion
+  and planner-priced rehome. Injectable via the ``sdc@N[#d]`` chaos
+  kind.
 
 See docs/RESILIENCE.md for the failure model and a chaos-testing
 how-to. Import discipline: this package sits below the expr layer
@@ -49,22 +56,24 @@ how-to. Import discipline: this package sits below the expr layer
 are reached lazily.
 """
 
-from . import classify, degrade, elastic, engine, faults, loop_ckpt, memory
-from .classify import (DETERMINISTIC, FATAL_MESH, IO, OOM, STALE_MESH,
-                       TRANSIENT, FatalMeshError,
+from . import (classify, degrade, elastic, engine, faults, integrity,
+               loop_ckpt, memory)
+from .classify import (DETERMINISTIC, FATAL_MESH, IO, OOM, SDC,
+                       STALE_MESH, TRANSIENT, FatalMeshError,
                        classify as classify_error)
 from .faults import (ChaosPlan, InjectedCheckpointError,
                      InjectedCompileError, InjectedDeviceLossError,
                      InjectedOOMError, InjectedTransientError, chaos,
                      chaos_clear)
+from .integrity import IntegrityError
 
 __all__ = [
     "chaos", "chaos_clear", "ChaosPlan", "classify_error",
     "TRANSIENT", "OOM", "IO", "DETERMINISTIC", "FATAL_MESH",
-    "STALE_MESH", "FatalMeshError",
+    "STALE_MESH", "SDC", "FatalMeshError", "IntegrityError",
     "InjectedTransientError", "InjectedOOMError",
     "InjectedCompileError", "InjectedCheckpointError",
     "InjectedDeviceLossError",
-    "classify", "degrade", "elastic", "engine", "faults", "loop_ckpt",
-    "memory",
+    "classify", "degrade", "elastic", "engine", "faults", "integrity",
+    "loop_ckpt", "memory",
 ]

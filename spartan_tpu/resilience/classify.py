@@ -26,6 +26,12 @@ class           what it covers                          policy
 ``stale_mesh``  a pre-rebuild DistArray/plan used       fail fast (or
                 after the mesh epoch advanced           rehome, for the
                 (``StaleMeshError``)                    loop driver)
+``sdc``         a failed integrity check: the SDC       discard + retry
+                sentinel's checksum cross-check         (the corrupt
+                disagreed (``IntegrityError``,          result is never
+                resilience/integrity.py)                returned; repeat
+                                                        offenders get
+                                                        quarantined)
 ``deterministic`` everything else: user errors          fail fast with
                 (ValueError/TypeError/ExprError),       the plan report
                 INVALID_ARGUMENT compile errors, ...    attached
@@ -47,6 +53,7 @@ IO = "io"
 DETERMINISTIC = "deterministic"
 FATAL_MESH = "fatal_mesh"
 STALE_MESH = "stale_mesh"
+SDC = "sdc"
 
 
 class FatalMeshError(RuntimeError):
@@ -99,13 +106,19 @@ _FATAL_MESH_MARKERS = (
 # death through the generic status
 _INTERNAL_DEVICE_MARKERS = ("device", "chip", "tpu core")
 
+# The integrity sentinel's verdict (resilience/integrity.py) — checked
+# before the transient table so a checksum mismatch never classifies
+# as a generic retryable fault (the sdc policy also counts strikes)
+_SDC_MARKERS = ("integrity violation", "silent data corruption",
+                "checksum mismatch")
+
 
 def _match(text: str, markers: tuple) -> bool:
     return any(m in text for m in markers)
 
 
 def classify(exc: BaseException) -> str:
-    """Map an exception to one of the six recovery classes."""
+    """Map an exception to one of the seven recovery classes."""
     kind = getattr(exc, "fault_kind", None)
     if kind is not None:  # injected faults label themselves, but their
         # messages ALSO match the patterns below; the attribute is just
@@ -114,9 +127,12 @@ def classify(exc: BaseException) -> str:
         # chaos `recover` seam) classifies transient: the triggering
         # operation retries, re-enters the idempotent recovery, and
         # finishes it
+        # "sdc" is the integrity sentinel's IntegrityError (a failed
+        # checksum cross-check), labelled through the same channel
         return {"transient": TRANSIENT, "oom": OOM, "io": IO,
                 "device_loss": FATAL_MESH, "recover": TRANSIENT,
-                "compile": DETERMINISTIC}.get(kind, DETERMINISTIC)
+                "compile": DETERMINISTIC, "sdc": SDC,
+                }.get(kind, DETERMINISTIC)
     if isinstance(exc, FatalMeshError):
         return FATAL_MESH
     # lazy: parallel.mesh is loaded long before any failure classifies
@@ -135,6 +151,8 @@ def classify(exc: BaseException) -> str:
         if text.startswith("internal") and _match(
                 text, _INTERNAL_DEVICE_MARKERS):
             return FATAL_MESH
+        if _match(text, _SDC_MARKERS):
+            return SDC
         if _match(text, _OOM_MARKERS):
             return OOM
         if _match(text, _TRANSIENT_MARKERS):

@@ -284,6 +284,76 @@ def _resume_serve() -> None:
         eng.resume_admission()
 
 
+def quarantine_device(device: int, reason: str = "sdc"
+                      ) -> Optional[Any]:
+    """PLANNED eviction of a healthy-looking-but-suspect device (the
+    SDC sentinel's remedy, resilience/integrity.py): the same drain ->
+    ``rebuild_mesh(exclude_devices=[device])`` -> evict -> resume
+    discipline as :func:`on_fatal_mesh`, but there is no exception and
+    no casualty to infer — the chip still answers, we just no longer
+    trust its arithmetic. Live arrays rehome lazily: their next use
+    raises ``StaleMeshError`` and the loop driver / caller routes them
+    through the planner-priced :func:`rehome`, so quarantine is a
+    costed migration, not a crash. Idempotent: quarantining an
+    already-excluded device returns the current mesh. Returns None
+    when elastic recovery is disabled."""
+    global _completed_epoch, _pending
+    if not FLAGS.elastic_recovery:
+        return None
+    with _lock:
+        if _completed_epoch > mesh_mod._EPOCH:
+            _completed_epoch = 0  # epoch reset (test isolation)
+            _pending = False
+        if int(device) in set(mesh_mod._excluded_ids):
+            if not _pending or _completed_epoch >= mesh_mod._EPOCH:
+                return mesh_mod.get_mesh()
+            return _finish_recovery(mesh_mod._EPOCH)
+        seen_epoch = mesh_mod._EPOCH
+        retry_after = FLAGS.elastic_retry_after_s
+        _pending = True
+        with prof.span("elastic_quarantine", epoch=seen_epoch,
+                       device=int(device), reason=reason) as sp:
+            with prof.phase("drain"):
+                _fire_recover()
+                drained = _drain_serve(retry_after)
+            with prof.phase("rebuild"):
+                _fire_recover()
+                new_mesh = mesh_mod.rebuild_mesh(
+                    exclude_devices=[int(device)])
+            from ..obs import monitor as monitor_mod
+
+            monitor_mod.notify_mesh_recovery()
+            from ..expr import base as expr_base
+
+            with prof.phase("evict"):
+                _fire_recover()
+                evicted = expr_base.evict_stale_plans()
+                persisted = persist_mod.last_evicted()
+            sp.set(drained=drained, evicted=evicted,
+                   persist_evicted=persisted,
+                   survivors=int(new_mesh.devices.size),
+                   from_shape=mesh_mod.mesh_shape_at(seen_epoch),
+                   to_shape={k: int(v)
+                             for k, v in new_mesh.shape.items()})
+        _completed_epoch = mesh_mod._EPOCH
+        _pending = False
+        _count("elastic_quarantines",
+               "suspect devices evicted by planned quarantine "
+               "(integrity sentinel)")
+        _count("elastic_plans_evicted",
+               "dead-epoch plans evicted during elastic recovery",
+               evicted)
+        _resume_serve()
+        log_warn(
+            "elastic: mesh epoch %d -> %d after QUARANTINE of device "
+            "%d (%s) — %d survivor(s), %d plan(s) evicted (+%d "
+            "persisted), %d serve request(s) drained; stale arrays "
+            "rehome on next use", seen_epoch, mesh_mod._EPOCH,
+            int(device), reason, int(new_mesh.devices.size), evicted,
+            persisted, drained)
+        return new_mesh
+
+
 def rehome(arrays: Sequence[Any]) -> int:
     """Migrate stale-epoch DistArrays onto the current mesh through
     the PLANNED migration pipeline (``DistArray.rehome`` ->

@@ -258,11 +258,23 @@ def handle_failure(exc: BaseException, expr: Any, plan: Any,
             raise exc
         return degrade.run_ladder(exc, expr, donated, mesh, plan)
 
-    if kind in (cls.TRANSIENT, cls.IO):
+    if kind in (cls.TRANSIENT, cls.IO, cls.SDC):
+        # sdc joins the retry classes: the integrity sentinel already
+        # discarded the corrupt result (IntegrityError carries no
+        # usable value), so the remedy is a clean re-dispatch — which
+        # lands on the post-quarantine mesh when this violation evicted
+        # the suspect (the retry then surfaces stale_mesh and the loop
+        # driver / serve engine rehomes).
         if _METRICS_FLAG._value:
-            REGISTRY.counter(
-                "resilience_transient_faults",
-                "dispatch failures classified transient/io").inc()
+            if kind == cls.SDC:
+                REGISTRY.counter(
+                    "resilience_sdc_faults",
+                    "dispatch results discarded by the integrity "
+                    "sentinel (failed checksum cross-check)").inc()
+            else:
+                REGISTRY.counter(
+                    "resilience_transient_faults",
+                    "dispatch failures classified transient/io").inc()
         if (not getattr(exc, "injected", False)
                 and _donation_in_flight(leaves, donated)):
             _attach_note(
@@ -328,7 +340,7 @@ def handle_failure(exc: BaseException, expr: Any, plan: Any,
                             raise
                         return degrade.run_ladder(e, expr, donated,
                                                   mesh, plan)
-                    if k2 not in (cls.TRANSIENT, cls.IO):
+                    if k2 not in (cls.TRANSIENT, cls.IO, cls.SDC):
                         _attach_note(
                             e, f"resilience: while retrying after a "
                             f"{kind} fault (attempt {attempt + 1})")

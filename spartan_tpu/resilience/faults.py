@@ -32,7 +32,24 @@ Injection sites (the real seams):
   triggered recovery instead of failing it deterministically).
 
 Spec grammar (``FLAGS.fault_inject`` or ``st.chaos(spec)``): a
-comma-separated list of tokens::
+comma-separated list of ``kind[@N][xCOUNT][#DEV][:PROB][=DUR]``
+tokens. The full grammar table (docs/RESILIENCE.md carries the same
+table):
+
+=========  ==============================================================
+ suffix     meaning
+=========  ==============================================================
+ ``@N``     fire at occurrence ``N`` (0-based) of the kind's site
+ ``xC``     ...and the ``C-1`` following occurrences (default 1)
+ ``#D``     victim device ordinal for kinds that name a casualty
+            (``device_loss``, ``sdc``); default: the highest-ordinal
+            device still in the mesh
+ ``:P``     instead of ``@N``: fire each occurrence with seeded
+            probability ``P`` (same seed -> same fault sequence)
+ ``=S``     duration in seconds (``slow`` only; default 0.05)
+=========  ==============================================================
+
+Examples::
 
     transient@2        dispatch occurrence #2 (0-based) raises once
     oom@4x3            dispatch occurrences 4,5,6 raise RESOURCE_EXHAUSTED
@@ -46,13 +63,23 @@ comma-separated list of tokens::
                        status) classified FatalMeshError -> elastic
                        recovery: drain, rebuild_mesh over survivors,
                        resume loops from checkpoint. The injected
-                       error names the simulated casualty (the
-                       highest-ordinal device) so the recovery path
-                       exercises exclusion without a real dead chip.
+                       error names the simulated casualty so the
+                       recovery path exercises exclusion without a
+                       real dead chip.
+    device_loss@2#3    same, but device ordinal 3 is the casualty.
     recover@1          the second probe of the RECOVERY seam raises a
                        transient fault — recovery itself dies mid-
                        drain/rebuild/rehome, and the next
                        handle_failure must finish it idempotently.
+    sdc@5              dispatch occurrence #5 SILENTLY corrupts its
+                       result: one deterministic seeded bit-flip in
+                       one output shard, applied after the executable
+                       runs. Nothing raises — the corruption flows to
+                       the caller unless the integrity sentinel
+                       (resilience/integrity.py, FLAGS.integrity_check)
+                       catches it.
+    sdc@5x3#2          occurrences 5,6,7; the flipped shard lives on
+                       device ordinal 2 (the seeded victim).
 
 Injected exceptions carry ``injected=True`` and messages matching the
 real-world patterns (``UNAVAILABLE``, ``RESOURCE_EXHAUSTED``,
@@ -86,8 +113,9 @@ FLAGS.define_str(
     "fault_inject", "",
     "Deterministic fault-injection spec (chaos testing): comma-"
     "separated tokens like 'transient@2', 'oom@4x3', 'transient:0.05', "
-    "'slow@3=0.5', 'compile@0', 'io@1'. Installed by st.initialize() "
-    "or st.chaos(); empty = no injection. See docs/RESILIENCE.md.")
+    "'slow@3=0.5', 'compile@0', 'io@1', 'sdc@5#2'. Installed by "
+    "st.initialize() or st.chaos(); empty = no injection. See "
+    "docs/RESILIENCE.md for the grammar table.")
 FLAGS.define_int(
     "fault_seed", 0,
     "Seed for probabilistic fault-injection tokens (kind:prob): the "
@@ -146,18 +174,30 @@ class InjectedDeviceLossError(RuntimeError):
         self.failed_devices = tuple(failed_devices)
 
 
-def _make_device_loss(msg: str, site: str, idx: int
-                      ) -> InjectedDeviceLossError:
-    """The simulated casualty is the highest-ordinal device still IN
-    the mesh: real losses name the dead chip in the status; here the
-    injection picks one deterministically so classifier tests and the
-    elastic acceptance scenario run without a real dead chip — and a
-    second injected loss kills a fresh survivor, not the same corpse.
-    Lazy import: the mesh layer is loaded long before any fault
-    fires."""
+def _pick_victim(dev: Optional[int]) -> int:
+    """Resolve a token's victim device: an explicit ``#D`` ordinal, or
+    the highest-ordinal device still IN the mesh — real losses name the
+    dead chip in the status; the injection picks one deterministically
+    so classifier tests and the elastic/integrity acceptance scenarios
+    run without a real dead chip, and a second injected loss kills a
+    fresh survivor, not the same corpse. Lazy import: the mesh layer is
+    loaded long before any fault fires."""
     from ..parallel import mesh as mesh_mod
 
-    victim = max(d.id for d in mesh_mod.get_mesh().devices.flat)
+    ids = sorted(d.id for d in mesh_mod.get_mesh().devices.flat)
+    if dev is not None:
+        if dev not in ids:
+            raise ValueError(
+                f"chaos victim #{dev} is not in the current mesh "
+                f"(devices {ids})")
+        return dev
+    return ids[-1]
+
+
+def _make_device_loss(msg: str, site: str, idx: int,
+                      dev: Optional[int] = None
+                      ) -> InjectedDeviceLossError:
+    victim = _pick_victim(dev)
     return InjectedDeviceLossError(
         msg.format(site=site, idx=idx, dev=victim),
         failed_devices=(victim,))
@@ -185,11 +225,14 @@ _EXC = {
 }
 
 _KINDS = ("transient", "oom", "slow", "compile", "io", "device_loss",
-          "recover")
+          "recover", "sdc")
+# kinds whose token may name a victim device ordinal with #D
+_VICTIM_KINDS = ("device_loss", "sdc")
 _TOKEN = re.compile(
     r"^(?P<kind>[a-z_]+)"
     r"(?:@(?P<at>\d+))?"
     r"(?:x(?P<count>\d+))?"
+    r"(?:#(?P<dev>\d+))?"
     r"(?::(?P<prob>[0-9.]+))?"
     r"(?:=(?P<dur>[0-9.]+))?$")
 
@@ -197,23 +240,30 @@ _TOKEN = re.compile(
 class FaultSpec:
     """One parsed token of a chaos spec."""
 
-    __slots__ = ("kind", "at", "count", "prob", "dur")
+    __slots__ = ("kind", "at", "count", "dev", "prob", "dur")
 
     def __init__(self, token: str):
         m = _TOKEN.match(token.strip())
         if not m or m.group("kind") not in _KINDS:
             raise ValueError(
                 f"bad fault token {token!r}: expected "
-                f"kind[@N][xCOUNT][:PROB][=DUR] with kind in {_KINDS}")
+                f"kind[@N][xCOUNT][#DEV][:PROB][=DUR] with kind in "
+                f"{_KINDS}")
         self.kind = m.group("kind")
         self.at = int(m.group("at")) if m.group("at") is not None else None
         self.count = int(m.group("count") or 1)
+        self.dev = int(m.group("dev")) if m.group("dev") is not None \
+            else None
         self.prob = float(m.group("prob")) if m.group("prob") else 0.0
         self.dur = float(m.group("dur")) if m.group("dur") else 0.05
         if self.at is None and not self.prob:
             raise ValueError(
                 f"fault token {token!r} needs a deterministic site "
                 "(@N) or a probability (:p)")
+        if self.dev is not None and self.kind not in _VICTIM_KINDS:
+            raise ValueError(
+                f"fault token {token!r}: #DEV victim selection only "
+                f"applies to {_VICTIM_KINDS}")
 
     def hits(self, idx: int, seed: int) -> bool:
         if self.at is not None and self.at <= idx < self.at + self.count:
@@ -228,7 +278,8 @@ class FaultSpec:
 
     def __repr__(self) -> str:
         return (f"FaultSpec({self.kind}, at={self.at}, "
-                f"count={self.count}, prob={self.prob})")
+                f"count={self.count}, dev={self.dev}, "
+                f"prob={self.prob})")
 
 
 class ChaosPlan:
@@ -250,6 +301,9 @@ class ChaosPlan:
         self._n_compile = 0
         self._n_checkpoint = 0
         self._n_recover = 0
+        # armed sdc corruption: (spec, occurrence) set by fire() when
+        # an sdc token matches, consumed post-run by corrupt_output()
+        self._pending_sdc: Optional[Any] = None
 
     # -- occurrence counters ------------------------------------------
 
@@ -313,10 +367,40 @@ class ChaosPlan:
             if spec.kind == "slow":
                 time.sleep(spec.dur)
                 continue
+            if spec.kind == "sdc":
+                # silent corruption raises NOTHING here: arm a pending
+                # bit-flip that corrupt_output() applies to this run's
+                # result after the executable finishes
+                with self._lock:
+                    self._pending_sdc = (spec, idx)
+                continue
             exc_type, msg = _EXC[spec.kind]
             if spec.kind == "device_loss":
-                raise _make_device_loss(msg, site, idx)
+                raise _make_device_loss(msg, site, idx, spec.dev)
             raise exc_type(msg.format(site=site, idx=idx))
+
+    def corrupt_output(self, out: Any) -> Any:
+        """Apply an armed ``sdc`` corruption to a just-produced result:
+        one deterministic seeded bit-flip in one output shard on the
+        victim device (``#D`` or the highest-ordinal device in the
+        mesh). Consumes the pending record; returns ``out`` unchanged
+        when nothing is armed. The actual buffer surgery lives in
+        resilience/integrity.py — the one sanctioned checksum/flip seam
+        (lint rule 18)."""
+        with self._lock:
+            pending, self._pending_sdc = self._pending_sdc, None
+        if pending is None:
+            return out
+        spec, idx = pending
+        try:
+            victim = _pick_victim(spec.dev)
+        except ValueError:
+            # the explicit #D victim is no longer in the mesh (the
+            # sentinel already quarantined it): nothing left to corrupt
+            return out
+        from . import integrity as integrity_mod
+
+        return integrity_mod.flip_bit(out, victim, self.seed, idx)
 
     # -- installation --------------------------------------------------
 
@@ -393,3 +477,14 @@ def fire(site: str) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.fire(site)
+
+
+def corrupt_output(out: Any) -> Any:
+    """Module-level post-run hook for the ``sdc`` kind: applies any
+    corruption armed by this dispatch's :func:`fire` call. The caller
+    (``expr/base._dispatch``) guards on ``_ACTIVE is not None``, so
+    chaos-off cost stays one attribute read."""
+    plan = _ACTIVE
+    if plan is None:
+        return out
+    return plan.corrupt_output(out)

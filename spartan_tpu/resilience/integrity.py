@@ -1,0 +1,568 @@
+"""Silent-data-corruption sentinel: detect, attribute, quarantine.
+
+Every other failure the resilience stack handles is *loud* — a raised
+status the classifier can route (transient, OOM, device loss). A chip
+that silently computes wrong bits raises nothing: the corruption flows
+through ``evaluate()`` and out to serve clients. Production TPU fleets
+treat silent data corruption (SDC) as a first-class failure mode and
+screen for it continuously; this module is that screen, built from the
+seams the repo already trusts:
+
+**Detect** (``FLAGS.integrity_check``). Sampled dispatches — riding the
+same ``FLAGS.profile_sample_every`` cadence as the continuous profiler,
+off the result path — get two pieces of evidence: a per-shard checksum
+of the result just produced, and a *redundant re-execution* of the same
+plan with the device assignment rotated (``parallel.mesh.rotated_mesh``
+— same shape, every logical shard on a different physical chip). The
+two executions run the same XLA program over the same topology, so they
+are bit-equal on a healthy fleet (the GSPMD partitioning, and hence the
+reduction order, does not depend on which physical chip holds which
+coordinate). Bit-equal per-shard checksums are the null case; any
+disagreement is an integrity violation, and the corrupt result is
+NEVER returned — ``maybe_check`` raises :class:`IntegrityError`
+(classifier class ``sdc``) and the policy engine re-dispatches.
+
+**Attribute**. A disagreeing shard implicates devices, not just plans:
+for each logical shard index, the checksums from both executions vote,
+and every device holding a minority value is implicated (with
+replicated outputs the vote is lopsided and names the culprit
+directly; with 1-copy-per-index shards it implicates the primary
+holder AND its rotated counterpart). Implicated devices accrue
+*strikes* in a bounded sliding window. Because the rotation offset
+advances on every check, an innocent device implicated only because it
+shadowed a bad chip under one rotation is not implicated under the
+next — its strikes age out of the window and it is *exonerated*, while
+a physically bad chip is implicated on every check regardless of
+assignment and accumulates.
+
+**Remedy**. A device whose in-window strikes reach
+``FLAGS.sdc_quarantine_strikes`` is a confirmed suspect: the sentinel
+emits a monitor ``sdc`` anomaly and triggers *planned* eviction —
+``elastic.quarantine_device`` drains the serve engine, calls
+``rebuild_mesh(exclude_devices=[suspect])``, evicts the dead epoch's
+plans and resumes; live arrays then rehome through the planner-priced
+``elastic.rehome`` path when their owners next touch them (loop
+drivers heal via the existing ``stale_mesh`` branch). Quarantine is a
+costed migration, not a crash.
+
+The chaos kind ``sdc@N[#d]`` (resilience/faults.py) injects a
+deterministic seeded bit-flip into one output shard post-run via
+:func:`flip_bit`, so the whole detect -> attribute -> quarantine
+pipeline is exercisable in CPU CI. This module is also the ONE place
+allowed to walk raw shard buffers for checksums (lint rule 18); the
+walk itself goes through ``obs.skew.local_shards_indexed`` (rule 17).
+
+What is NOT covered (docs/RESILIENCE.md "Silent data corruption"):
+corruption in an unsampled dispatch (cadence is a screen, not a
+proof), corruption that strikes both executions identically, host-side
+corruption after the checksum, and donated-argument dispatches (the
+inputs are consumed, so no redundant run is possible — those are
+skipped).
+
+Hot-path contract: one flag read per dispatch when
+``FLAGS.integrity_check`` is off; on the sampled path the redundant
+run roughly doubles that dispatch's device time (reported by
+``benchmarks/integrity_overhead.py``, unjudged).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import profile as profile_mod
+from ..obs import skew as skew_mod
+from ..obs import trace as trace_mod
+from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
+from ..obs.metrics import REGISTRY, labeled
+from ..utils.config import FLAGS
+from ..utils.log import log_warn
+
+_CHECK_FLAG = FLAGS.define_bool(
+    "integrity_check", False,
+    "Screen sampled dispatches for silent data corruption: per-shard "
+    "checksum + redundant re-execution on a rotated device assignment "
+    "(rides the profile_sample_every cadence). A disagreement raises "
+    "IntegrityError (class 'sdc') instead of returning the corrupt "
+    "result; repeat offenders are quarantined out of the mesh. One "
+    "flag read per dispatch when off.")
+_STRIKES_FLAG = FLAGS.define_int(
+    "sdc_quarantine_strikes", 3,
+    "In-window strikes that confirm a suspect device and trigger "
+    "planned quarantine (rebuild_mesh excluding it + planner-priced "
+    "rehome). Devices whose strikes age out of the window first are "
+    "exonerated.")
+
+# strike window (in violations, not seconds): strikes older than this
+# many violations ago age out — the exoneration horizon
+_WINDOW = 32
+# bounded per-plan state
+_COUNTS_MAX = 256
+_LAST_MAX = 32
+_JIT_MAX = 8
+_HISTORY_MAX = 16
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+_counts: Dict[str, int] = {}                 # plan digest -> dispatches seen
+_last_by_plan: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_rot_jit: "OrderedDict[Tuple[Any, ...], Any]" = OrderedDict()
+_strikes: Dict[int, Any] = {}                # device id -> deque of seqs
+_exonerated: Dict[int, int] = {}             # device id -> times exonerated
+_history: Any = deque(maxlen=_HISTORY_MAX)   # quarantine records
+_seq = 0                                     # violation sequence number
+_checks = 0
+_violations = 0
+
+
+class IntegrityError(RuntimeError):
+    """A sampled dispatch failed its checksum cross-check: the result
+    just produced disagrees per-shard with a redundant re-execution of
+    the same plan. The result is discarded (never wrapped, cached, or
+    resolved to a serve client); the policy engine re-dispatches.
+    ``suspects`` names the implicated device ordinals; ``quarantined``
+    is set when this violation crossed the strike threshold and the
+    suspect was evicted from the mesh (the retry will then see a
+    StaleMeshError and rehome through the elastic path)."""
+
+    fault_kind = "sdc"
+
+    def __init__(self, msg: str, suspects: Sequence[int] = (),
+                 quarantined: Optional[int] = None):
+        super().__init__(msg)
+        self.suspects = tuple(suspects)
+        self.quarantined = quarantined
+
+
+# -- the checksum walk (lint rule 18: confined to this module) ----------
+
+
+def shard_checksums(jarr: Any) -> List[Tuple[Any, int, int]]:
+    """Exact per-shard evidence: ``(index_key, device_id, crc32)`` per
+    addressable shard, sorted by logical index. The portable tier folds
+    on host (one device_get per shard — sampled path only); a TPU
+    deployment can swap in a device-side bitcast-reduce without
+    changing the comparison, which only needs equality."""
+    recs = []
+    for dev, idx, data in skew_mod.local_shards_indexed(jarr):
+        h = np.ascontiguousarray(np.asarray(data))
+        recs.append((_index_key(idx), int(dev.id),
+                     zlib.crc32(h.tobytes())))
+    recs.sort()
+    return recs
+
+
+def _index_key(idx: Any) -> Tuple:
+    try:
+        return tuple(
+            (int(s.start or 0), -1 if s.stop is None else int(s.stop))
+            for s in idx)
+    except TypeError:
+        return (str(idx),)
+
+
+def flip_bit(out: Any, victim: int, seed: int, occurrence: int) -> Any:
+    """The chaos ``sdc`` kind's buffer surgery: flip one deterministic
+    seeded bit in the first output shard resident on ``victim``,
+    rebuilding the array around the corrupt shard. Returns ``out``
+    unchanged when no shard lives on the victim. Deterministic given
+    (seed, occurrence) — the same chaos spec reproduces the same
+    corrupt bit."""
+    if isinstance(out, tuple):
+        lst = list(out)
+        for i, o in enumerate(lst):
+            o2 = _flip_array(o, victim, seed, occurrence)
+            if o2 is not o:
+                lst[i] = o2
+                return tuple(lst)
+        return out
+    return _flip_array(out, victim, seed, occurrence)
+
+
+def _flip_array(jarr: Any, victim: int, seed: int, occurrence: int
+                ) -> Any:
+    import jax
+
+    try:
+        shards = skew_mod.local_shards_indexed(jarr)
+    except Exception:
+        return jarr
+    word = zlib.crc32(f"{seed}:sdc:{occurrence}".encode())
+    bufs = []
+    done = False
+    for dev, _idx, data in shards:
+        h = np.asarray(data)
+        if not done and int(dev.id) == victim and h.size:
+            b = np.ascontiguousarray(h).copy()
+            flat = b.view(np.uint8).reshape(-1)
+            flat[word % flat.size] ^= np.uint8(1 << ((word >> 8) % 8))
+            h = b
+            done = True
+        bufs.append(jax.device_put(h, dev))
+    if not done:
+        return jarr
+    return jax.make_array_from_single_device_arrays(
+        jarr.shape, jarr.sharding, bufs)
+
+
+# -- detect -------------------------------------------------------------
+
+
+def maybe_check(expr: Any, plan: Any, phase_name: str, out: Any,
+                args: Sequence[Any], dpos: Any, mesh: Any) -> None:
+    """The dispatch hook: every Nth non-donating run of a plan gets a
+    full cross-check (N = ``max(1, FLAGS.profile_sample_every)``, the
+    profiler's cadence). Raises :class:`IntegrityError` on a failed
+    check; returns silently otherwise. Internal check errors (a shard
+    walk that fails, a re-execution that faults) are counted and
+    swallowed — the sentinel never fails a healthy dispatch by
+    accident."""
+    if dpos:
+        return  # donated inputs are consumed: no redundant run exists
+    report = plan.report
+    digest = report.get("plan_key") if report else None
+    if digest is None:
+        return
+    n = max(1, profile_mod._SAMPLE_FLAG._value)
+    with _lock:
+        c = _counts.get(digest, 0) + 1
+        _counts[digest] = c
+        while len(_counts) > _COUNTS_MAX:
+            _counts.pop(next(iter(_counts)))
+    if c % n != 0:
+        return
+    _check(plan, out, args, mesh, digest)
+
+
+def _check(plan: Any, out: Any, args: Sequence[Any], mesh: Any,
+           digest: str) -> None:
+    global _checks, _violations
+    try:
+        with trace_mod.span("integrity_check", plan=digest):
+            outs = out if plan.is_tuple else (out,)
+            primary = [shard_checksums(o) for o in outs]
+            with _lock:
+                k = 1 + (_checks % max(1, mesh.devices.size - 1))
+            out2 = _rerun_rotated(plan, args, mesh, digest, k)
+            outs2 = out2 if plan.is_tuple else (out2,)
+            reference = [shard_checksums(o) for o in outs2]
+            disagreements = _compare(primary, reference)
+    except Exception as e:  # pragma: no cover - defensive
+        if _METRICS_FLAG._value:
+            REGISTRY.counter(
+                "integrity_check_errors",
+                "integrity checks that failed internally (walk or "
+                "redundant re-execution error), skipped").inc()
+        log_warn("integrity: check failed internally (%s); skipping",
+                 e)
+        return
+    with _lock:
+        _checks += 1
+        checks = _checks
+    verdict: Dict[str, Any] = {
+        "verdict": "ok" if not disagreements else "violation",
+        "plan": digest, "check": checks, "rotation": k,
+        "t": trace_mod.now(),
+    }
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "integrity_checks",
+            "sampled dispatches screened by the SDC sentinel "
+            "(checksum + redundant re-execution cross-check)").inc()
+    if not disagreements:
+        _stamp(digest, plan, verdict)
+        return
+    # -- violation: attribute, strike, maybe quarantine -----------------
+    implicated = sorted({d for rec in disagreements
+                         for d in rec["devices"]})
+    verdict.update(shards=len(disagreements), suspects=implicated)
+    with _lock:
+        _violations += 1
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "integrity_violations",
+            "integrity checks whose per-shard checksums disagreed "
+            "between the primary and the rotated redundant run").inc()
+    trace_mod.instant("integrity_violation", error=True, plan=digest,
+                      shards=len(disagreements),
+                      suspects=str(implicated))
+    log_warn("integrity: violation on plan %s — %d shard(s) disagree, "
+             "implicating devices %s", digest, len(disagreements),
+             implicated)
+    suspect = note_violation(implicated)
+    with _lock:
+        verdict["strikes"] = {str(d): len(_strikes.get(d, ()))
+                              for d in implicated}
+    if suspect is not None:
+        verdict["quarantined"] = suspect
+        _stamp(digest, plan, verdict)
+        _quarantine(suspect, implicated, digest)
+        raise IntegrityError(
+            f"integrity violation: per-shard checksum mismatch on plan "
+            f"{digest} ({len(disagreements)} shard(s)); device "
+            f"{suspect} crossed {max(1, _STRIKES_FLAG._value)} strikes "
+            f"and was quarantined — the result was discarded; retry "
+            f"lands on the post-quarantine mesh",
+            suspects=implicated, quarantined=suspect)
+    _stamp(digest, plan, verdict)
+    raise IntegrityError(
+        f"integrity violation: per-shard checksum mismatch on plan "
+        f"{digest} ({len(disagreements)} shard(s), suspect devices "
+        f"{implicated}) — the result was discarded; a clean retry "
+        f"follows", suspects=implicated)
+
+
+def _rerun_rotated(plan: Any, args: Sequence[Any], mesh: Any,
+                   digest: str, k: int) -> Any:
+    """Redundant execution of ``plan.traced`` with every input moved to
+    the rotation-``k`` device assignment. One jitted wrapper per (plan,
+    epoch, rotation) is kept in a bounded cache; the rotated mesh
+    itself is built per check and dropped — never installed, never
+    cached (the epoch machinery only governs the one global mesh)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..parallel import mesh as mesh_mod
+
+    key = (digest, mesh_mod.mesh_epoch(), k)
+    with _lock:
+        jitted = _rot_jit.get(key)
+    if jitted is None:
+        # A FRESH wrapper function per (plan, epoch, rotation): jax's
+        # trace cache keys on the underlying callable's identity, so
+        # jitting ``plan.traced`` directly would reuse the jaxpr traced
+        # for the primary run — with the output sharding constraints
+        # (original assignment) baked into its eqn params. The wrapper
+        # forces a retrace, and the retrace runs under the rotated-mesh
+        # pin below, binding every ambient-resolved constraint to the
+        # rotated assignment.
+        traced = plan.traced
+
+        def _rot(*a: Any) -> Any:
+            return traced(*a)
+
+        jitted = jax.jit(_rot)
+        with _lock:
+            _rot_jit[key] = jitted
+            while len(_rot_jit) > _JIT_MAX:
+                _rot_jit.popitem(last=False)
+    rmesh = mesh_mod.rotated_mesh(mesh, k)
+    if rmesh is None:  # single device: plain re-execution
+        return jitted(*args)
+    rargs = []
+    for a in args:
+        sh = getattr(a, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            rargs.append(jax.device_put(a, NamedSharding(rmesh, sh.spec)))
+        else:
+            rargs.append(a)
+    with mesh_mod.use_mesh(rmesh):
+        return jitted(*rargs)
+
+
+def _compare(primary: List[List[Tuple]], reference: List[List[Tuple]]
+             ) -> List[Dict[str, Any]]:
+    """Vote per logical shard index: every checksum from both runs is a
+    ballot; devices holding a minority value are implicated. With
+    replicated outputs the healthy copies outvote the corrupt one and
+    name the culprit directly; with one copy per index the vote ties
+    1-1 and implicates the holder from EACH run — the strike window
+    plus the advancing rotation then separates the bad chip from its
+    one-time shadow."""
+    out: List[Dict[str, Any]] = []
+    for leaf, (a_recs, b_recs) in enumerate(zip(primary, reference)):
+        by_index: Dict[Any, List[Tuple[int, int]]] = {}
+        for idx, dev, crc in a_recs + b_recs:
+            by_index.setdefault(idx, []).append((dev, crc))
+        for idx, votes in sorted(by_index.items()):
+            crcs = [crc for _, crc in votes]
+            if len(set(crcs)) <= 1:
+                continue
+            counts: Dict[int, int] = {}
+            for crc in crcs:
+                counts[crc] = counts.get(crc, 0) + 1
+            best = max(counts.values())
+            majority = {crc for crc, n in counts.items() if n == best}
+            if len(majority) > 1:  # tie: implicate every holder
+                losers = {dev for dev, _ in votes}
+            else:
+                truth = next(iter(majority))
+                losers = {dev for dev, crc in votes if crc != truth}
+            out.append({"leaf": leaf, "index": str(idx),
+                        "devices": sorted(losers)})
+    return out
+
+
+# -- attribute ----------------------------------------------------------
+
+
+def note_violation(implicated: Sequence[int]) -> Optional[int]:
+    """Record one violation's implicated devices in the strike window;
+    returns the device to quarantine when one crossed
+    ``FLAGS.sdc_quarantine_strikes`` (the worst offender, ties to the
+    lowest ordinal), else None. Devices whose strikes all aged out of
+    the window are exonerated (counted, gauge cleared). Pure
+    bookkeeping — separable from the checksum machinery so the
+    attribution policy is unit-testable with synthetic violations."""
+    global _seq
+    threshold = max(1, _STRIKES_FLAG._value)
+    with _lock:
+        _seq += 1
+        seq = _seq
+        for d in implicated:
+            _strikes.setdefault(int(d), deque(maxlen=_WINDOW)).append(seq)
+        for d in list(_strikes):
+            dq = _strikes[d]
+            while dq and dq[0] <= seq - _WINDOW:
+                dq.popleft()
+            if not dq:
+                del _strikes[d]
+                _exonerated[d] = _exonerated.get(d, 0) + 1
+                if _METRICS_FLAG._value:
+                    labeled_g = REGISTRY.gauge(
+                        labeled("integrity_strikes", device=str(d)),
+                        "in-window SDC strikes per device")
+                    labeled_g.set(0.0)
+                log_warn("integrity: device %d exonerated (strikes "
+                         "aged out of the window)", d)
+        if _METRICS_FLAG._value:
+            for d in implicated:
+                REGISTRY.gauge(
+                    labeled("integrity_strikes", device=str(d)),
+                    "in-window SDC strikes per device"
+                ).set(float(len(_strikes.get(int(d), ()))))
+        worst: Optional[int] = None
+        for d in sorted(_strikes):
+            n = len(_strikes[d])
+            if n >= threshold and (worst is None
+                                   or n > len(_strikes[worst])):
+                worst = d
+        return worst
+
+
+# -- remedy -------------------------------------------------------------
+
+
+def _quarantine(suspect: int, implicated: Sequence[int], digest: str
+                ) -> None:
+    """Planned eviction of a confirmed suspect: monitor ``sdc`` anomaly
+    + ``elastic.quarantine_device`` (drain -> rebuild_mesh excluding
+    the suspect -> evict the dead epoch -> resume). Lazy imports keep
+    this module below the monitor/elastic layers until a quarantine
+    actually fires."""
+    from ..obs import monitor as monitor_mod
+    from ..parallel import mesh as mesh_mod
+    from . import elastic as elastic_mod
+
+    threshold = max(1, _STRIKES_FLAG._value)
+    with _lock:
+        strikes = len(_strikes.get(suspect, ()))
+    monitor_mod.note_anomaly(
+        "sdc", key=f"device{suspect}", value=float(strikes),
+        threshold=float(threshold),
+        detail=f"integrity violations implicated device {suspect} "
+               f"{strikes}x in-window (plan {digest}); quarantining")
+    if _METRICS_FLAG._value:
+        REGISTRY.counter(
+            "integrity_quarantines",
+            "suspect devices evicted from the mesh by the SDC "
+            "sentinel's planned quarantine").inc()
+    epoch_from = mesh_mod.mesh_epoch()
+    elastic_mod.quarantine_device(suspect, reason="sdc")
+    rec = {"device": int(suspect), "strikes": strikes,
+           "epoch_from": epoch_from,
+           "epoch_to": mesh_mod.mesh_epoch(), "t": trace_mod.now()}
+    with _lock:
+        _history.append(rec)
+        _strikes.pop(suspect, None)
+    log_warn("integrity: device %d quarantined after %d strikes "
+             "(mesh epoch %d -> %d)", suspect, strikes,
+             rec["epoch_from"], rec["epoch_to"])
+
+
+# -- surfaces (st.status / st.explain / serve flight) -------------------
+
+
+def _stamp(digest: str, plan: Any, verdict: Dict[str, Any]) -> None:
+    with _lock:
+        _last_by_plan[digest] = verdict
+        _last_by_plan.move_to_end(digest)
+        while len(_last_by_plan) > _LAST_MAX:
+            _last_by_plan.popitem(last=False)
+    if plan.report is not None:
+        plan.report["integrity"] = dict(verdict)
+    pending = getattr(_tls, "last_check", None)
+    if pending is None:
+        pending = {"checks": 0, "violations": 0}
+        _tls.last_check = pending
+    pending["checks"] += 1
+    pending["plan"] = digest
+    pending["verdict"] = verdict["verdict"]
+    if verdict["verdict"] != "ok":
+        pending["violations"] += 1
+        pending["suspects"] = verdict.get("suspects")
+    if verdict.get("quarantined") is not None:
+        pending["quarantined"] = verdict["quarantined"]
+
+
+def take_last_check() -> Optional[Dict[str, Any]]:
+    """Pop the calling thread's integrity summary since the last pop —
+    the serve worker flight-records it per request (checks may
+    accumulate across policy-engine retries; a violation survives the
+    clean retry's stamp)."""
+    out = getattr(_tls, "last_check", None)
+    _tls.last_check = None
+    return out
+
+
+def status() -> Optional[Dict[str, Any]]:
+    """The ``st.status()`` integrity line: checks run, violations,
+    in-window strikes per device, exonerations, quarantine history.
+    None when the sentinel has never run (keeps status terse)."""
+    with _lock:
+        if not _checks and not _history and not _strikes:
+            return None
+        return {
+            "checks": _checks,
+            "violations": _violations,
+            "strikes": {str(d): len(dq)
+                        for d, dq in sorted(_strikes.items())},
+            "exonerated": {str(d): n
+                           for d, n in sorted(_exonerated.items())},
+            "quarantined": [dict(r) for r in _history],
+            "window": _WINDOW,
+            "threshold": max(1, _STRIKES_FLAG._value),
+        }
+
+
+def current() -> Dict[str, Dict[str, Any]]:
+    """Latest verdict per plan digest (bounded), for st.explain and
+    tests."""
+    with _lock:
+        return {k: dict(v) for k, v in _last_by_plan.items()}
+
+
+def quarantine_history() -> List[Dict[str, Any]]:
+    with _lock:
+        return [dict(r) for r in _history]
+
+
+def reset() -> None:
+    """Test hook: drop all sentinel state (counters, strikes, caches)."""
+    global _seq, _checks, _violations
+    with _lock:
+        _counts.clear()
+        _last_by_plan.clear()
+        _rot_jit.clear()
+        _strikes.clear()
+        _exonerated.clear()
+        _history.clear()
+        _seq = 0
+        _checks = 0
+        _violations = 0
+    _tls.last_check = None

@@ -56,6 +56,7 @@ from ..obs.metrics import METRICS_FLAG as _METRICS_FLAG
 from ..obs.metrics import REGISTRY, labeled
 from ..parallel import mesh as mesh_mod
 from ..resilience import engine as resilience_engine
+from ..resilience import integrity as integrity_mod
 from ..resilience import memory as memory_mod
 from ..utils import profiling as prof
 from ..utils.config import FLAGS
@@ -65,6 +66,21 @@ from . import coalesce
 from .future import (Backpressure, CommBudgetExceeded, DeadlineExceeded,
                      EvalFuture, MeshReconfiguring)
 from .queue import AdmissionQueue
+
+
+def _sdc_in_chain(e: Optional[BaseException]) -> bool:
+    """True when this failure originated in an integrity violation:
+    either it IS the sentinel's IntegrityError (class 'sdc'), or it is
+    the StaleMeshError the policy engine's post-quarantine retry
+    surfaced while handling one (implicit exception chaining keeps the
+    IntegrityError on __context__)."""
+    seen = 0
+    while e is not None and seen < 8:
+        if resilience_classify.classify(e) == resilience_classify.SDC:
+            return True
+        e = e.__cause__ or e.__context__
+        seen += 1
+    return False
 
 FLAGS.define_int(
     "serve_workers", 2,
@@ -513,6 +529,14 @@ class ServeEngine:
                     sk = skew_mod.take_last_sample()
                     if sk is not None:
                         flight_mod.note(req.rid, "skew", **sk)
+            if integrity_mod._CHECK_FLAG._value:
+                # the SDC sentinel's verdicts for this request's
+                # dispatch (including violations discarded and retried
+                # by the policy engine mid-evaluate): flight-recorded
+                # so a corrupt-then-retried request is auditable
+                ic = integrity_mod.take_last_check()
+                if ic is not None:
+                    flight_mod.note(req.rid, "integrity", **ic)
 
     def _predict_service_s(self, r: "_Request") -> float:
         """This request's service-time prediction: the calibrated
@@ -741,8 +765,43 @@ class ServeEngine:
                     mr.__cause__ = e
                     r.future._reject(mr)
                     return
+                if _sdc_in_chain(e):
+                    # the integrity sentinel discarded this request's
+                    # result (and may have quarantined the suspect,
+                    # surfacing stale_mesh on the engine's retry): the
+                    # client NEVER sees the corrupt value — retry once
+                    # on the CURRENT (post-quarantine) mesh, rehoming
+                    # stale leaves through the planner-priced elastic
+                    # path, flight-recorded either way.
+                    self._sdc_retry(r, e)
+                    return
                 r.future._reject(e)
                 return
+        r.future.coalesced = 1
+        r.future._resolve(result)
+
+    def _sdc_retry(self, r: _Request, exc: Exception) -> None:
+        from ..resilience import elastic as elastic_mod
+
+        if flight_mod._FLIGHT_FLAG._value:
+            flight_mod.note(
+                r.rid, "sdc_retry",
+                quarantined=getattr(exc, "quarantined", None))
+        try:
+            with mesh_mod.use_mesh(mesh_mod.get_mesh()), \
+                    resilience_engine.tenant_scope(r.tenant), \
+                    numerics_mod.deadline_scope(r.remaining_s()):
+                for _ in range(3):  # rehome passes, like st.loop's
+                    try:
+                        result = base.evaluate(r.expr, donate=r.donate)
+                        break
+                    except mesh_mod.StaleMeshError as se:
+                        elastic_mod.rehome(getattr(se, "arrays", ()))
+                else:
+                    result = base.evaluate(r.expr, donate=r.donate)
+        except Exception as e2:
+            r.future._reject(e2)
+            return
         r.future.coalesced = 1
         r.future._resolve(result)
 

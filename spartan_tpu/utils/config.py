@@ -315,6 +315,15 @@ FLAGS.define_int("log_level", 2, "0=debug 1=info 2=warn 3=error")
 #       CPU: governor inert unless set explicitly).
 #   loop_restore_max     (loop_ckpt.py, default 3)   — checkpoint
 #       restores per checkpointed st.loop before the failure escapes.
+#   integrity_check      (integrity.py, default False) — the SDC
+#       sentinel: sampled per-shard checksum + redundant re-execution
+#       on a rotated device assignment (rides profile_sample_every);
+#       a disagreement discards the result (class 'sdc') and strikes
+#       the implicated devices (benchmarks/integrity_overhead.py <=1%
+#       off-path gate).
+#   sdc_quarantine_strikes (integrity.py, default 3) — in-window
+#       strikes that confirm a suspect device and trigger its planned
+#       quarantine (rebuild_mesh exclusion + planner-priced rehome).
 FLAGS.define_bool(
     "trace_annotations", True,
     "Wrap every expr node's kernel body in jax.named_scope during "

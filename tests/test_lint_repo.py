@@ -635,3 +635,33 @@ def test_shard_walks_allowed_in_owners():
                 os.path.join("spartan_tpu", "expr", "base.py")):
         path = os.path.join(lint_repo.REPO, rel)
         assert lint_repo.lint_shard_walks(path, tree) != []
+
+
+def test_catches_checksum_walks(tmp_path):
+    bad = tmp_path / "sum_mod.py"
+    bad.write_text(
+        "from spartan_tpu.resilience import integrity\n"
+        "def verify(jarr):\n"
+        "    return integrity.shard_checksums(jarr)\n"
+        "def chaos(out):\n"
+        "    return flip_bit(out, 0, 0, 0)\n")
+    tree = ast.parse(bad.read_text(), filename=str(bad))
+    findings = lint_repo.lint_checksum_walks(str(bad), tree)
+    assert sum(f.rule == "checksum-walk" for f in findings) == 2
+    # ... and the sanctioned seam is named in the remedy
+    assert all("integrity" in f.message for f in findings)
+
+
+def test_checksum_walks_allowed_in_integrity_seam():
+    tree = ast.parse("def f(jarr):\n"
+                     "    return shard_checksums(jarr)\n")
+    for rel in (os.path.join("spartan_tpu", "resilience", "integrity.py"),
+                os.path.join("spartan_tpu", "resilience", "faults.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_checksum_walks(path, tree) == []
+    # checksum comparison anywhere else — even elsewhere in the
+    # resilience layer — single-sources through integrity.py
+    for rel in (os.path.join("spartan_tpu", "resilience", "engine.py"),
+                os.path.join("spartan_tpu", "serve", "engine.py")):
+        path = os.path.join(lint_repo.REPO, rel)
+        assert lint_repo.lint_checksum_walks(path, tree) != []

@@ -1,6 +1,6 @@
 """AST-based custom lint for the spartan_tpu codebase itself.
 
-Sixteen repo-specific rules that generic linters cannot know:
+Eighteen repo-specific rules that generic linters cannot know:
 
 1. ``shard_map`` must be imported ONLY through the version-compat shim
    ``spartan_tpu/utils/compat.py`` (PR 1): importing it from jax
@@ -172,6 +172,24 @@ Sixteen repo-specific rules that generic linters cannot know:
     section, and leaks past ``shutdown()``. Locks / Events /
     Conditions are fine everywhere — the rule is about threads of
     execution, not synchronization primitives.
+
+17. No raw ``addressable_shards`` iteration outside the shard-walk
+    seam (``obs/skew.local_shards`` / ``per_shard_stats``), the array
+    layer that owns the buffers, and the checkpoint serializer — the
+    skew-observatory PR: every per-tile read-out must agree on device
+    labels, index formatting and host-fetch behavior, or straggler
+    attribution, tile health and checkpoints disagree about which
+    shard is which.
+
+18. No per-shard checksum walks or shard-buffer bit surgery
+    (``shard_checksums`` / ``flip_bit``) outside the integrity seam —
+    ``resilience/integrity.py`` (the SDC sentinel that owns both) and
+    ``resilience/faults.py`` (the chaos injector that delegates its
+    ``sdc`` corruption to it) — the SDC-sentinel PR: a checksum
+    computed elsewhere drifts on shard ordering and byte layout, so
+    its verdicts stop matching the sentinel's detect/attribute
+    pipeline, and a buffer flip outside the seam is silent data
+    corruption the sentinel cannot distinguish from the real thing.
 
 Run stand-alone (``python tools/lint_repo.py``; exit 1 on findings;
 ``--json`` emits the findings as a JSON array for CI tooling) or as a
@@ -355,6 +373,16 @@ _SHARDS_ALLOWED_FILES = {
     os.path.join("spartan_tpu", "obs", "skew.py"),
     os.path.join("spartan_tpu", "utils", "checkpoint.py"),
 }
+
+# rule 18: per-shard checksum walks and shard-buffer bit surgery are
+# the integrity seam — the SDC sentinel owns both ends (detect AND
+# inject), so checksums never drift on shard ordering/byte layout and
+# every deliberate flip is one the sentinel can account for
+_CHECKSUM_ALLOWED_FILES = {
+    os.path.join("spartan_tpu", "resilience", "integrity.py"),
+    os.path.join("spartan_tpu", "resilience", "faults.py"),
+}
+_CHECKSUM_NAMES = {"shard_checksums", "flip_bit"}
 
 
 class Finding:
@@ -745,6 +773,36 @@ def lint_shard_walks(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+def lint_checksum_walks(path: str, tree: ast.AST) -> List[Finding]:
+    """Rule 18: no ``shard_checksums`` / ``flip_bit`` references
+    outside the integrity seam (resilience/integrity.py owns both, the
+    chaos injector in resilience/faults.py delegates to it) — a
+    checksum walk elsewhere drifts on shard ordering and byte layout
+    so its verdicts stop matching the SDC sentinel's, and bit surgery
+    outside the seam is corruption the sentinel cannot attribute."""
+    rel = os.path.relpath(path, REPO)
+    if rel in _CHECKSUM_ALLOWED_FILES:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Attribute) and node.attr in _CHECKSUM_NAMES:
+            name = node.attr
+        elif isinstance(node, ast.Name) and node.id in _CHECKSUM_NAMES:
+            name = node.id
+        if name is not None:
+            findings.append(Finding(
+                path, node.lineno, "checksum-walk",
+                f"{name} outside the integrity seam: per-shard "
+                "checksums and shard-buffer bit surgery are "
+                "single-sourced in resilience/integrity.py (the SDC "
+                "sentinel) with resilience/faults.py's chaos injector "
+                "as the one delegating caller — route detection "
+                "through integrity.maybe_check and injection through "
+                "the sdc chaos kind (docs/RESILIENCE.md)"))
+    return findings
+
+
 def lint_raw_profiling(path: str, tree: ast.AST) -> List[Finding]:
     """Rule 9: no raw jax.profiler use outside obs/trace.py +
     obs/profile.py, and no direct cost_analysis / memory_analysis
@@ -1130,6 +1188,7 @@ def run_lint(root: str = PACKAGE) -> List[Finding]:
         findings.extend(lint_dynamic_slices(path, tree))
         findings.extend(lint_background_threads(path, tree))
         findings.extend(lint_shard_walks(path, tree))
+        findings.extend(lint_checksum_walks(path, tree))
     findings.extend(lint_expr_subclasses(files))
     return findings
 
